@@ -1,0 +1,30 @@
+"""tepdist_tpu — a TPU-native automatic distributed-training framework.
+
+Capabilities mirror alibaba/TePDist (see /root/reference, SURVEY.md): a
+client/server system where a JAX frontend sends a whole training step to a
+service that automatically plans a hybrid distribution strategy (DP / tensor
+sharding / ZeRO-style variable splitting / gradient-accumulation
+micro-batching / ILP-cut pipeline stages), partitions the module, compiles the
+pieces, and executes them on TPU via PJRT/XLA with server-held sharded
+variables, sharded RNG init, and distributed checkpoint.
+
+Mechanisms are TPU-idiomatic rather than ports: the planner works on jaxprs
+and emits GSPMD shardings (jax.sharding.NamedSharding) that XLA's SPMD
+partitioner lowers onto ICI collectives; pipeline parallelism runs as a
+collective-permute 1F1B schedule inside one compiled program; NCCL/CUDA-event
+machinery from the reference has no equivalent here by design.
+"""
+
+__version__ = "0.1.0"
+
+from tepdist_tpu.core.dist_spec import DimStrategy, DistSpec, TensorStrategy
+from tepdist_tpu.core.mesh import MeshTopology, SplitId
+
+__all__ = [
+    "DimStrategy",
+    "DistSpec",
+    "TensorStrategy",
+    "MeshTopology",
+    "SplitId",
+    "__version__",
+]

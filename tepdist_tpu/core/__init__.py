@@ -1,0 +1,14 @@
+from tepdist_tpu.core.dist_spec import DimStrategy, DistSpec, TensorStrategy
+from tepdist_tpu.core.mesh import MeshTopology, SplitId
+from tepdist_tpu.core.par_type import ParType
+from tepdist_tpu.core.service_env import ServiceEnv
+
+__all__ = [
+    "DimStrategy",
+    "DistSpec",
+    "TensorStrategy",
+    "MeshTopology",
+    "SplitId",
+    "ParType",
+    "ServiceEnv",
+]

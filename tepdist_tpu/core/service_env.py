@@ -1,0 +1,125 @@
+"""Declarative env/config knob table (reference: service_env.h:37-66).
+
+Every knob is readable from the environment or a JSON config file
+(``TEPDIST_CONFIG`` or ``config.json`` in the CWD), with env taking
+precedence — matching the reference's ``SERVICE_ENV_LIST`` +
+``LoadConfigFileSettings`` behavior. Knobs keep the reference's names where
+the concept carried over; CUDA/NCCL-only knobs were dropped and TPU knobs
+added (marked [tpu]).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+_DEF = object()
+
+# (name, type, default, help)
+_ENV_LIST: List[Tuple[str, type, Any, str]] = [
+    ("DEBUG", bool, False, "verbose task/step logging"),
+    ("CLUSTER_SPEC", str, "", "json cluster topology (multi-host)"),
+    ("RULE_MODE", bool, False, "use fast rule-based SPMD inference, skip ILP"),
+    ("IGNORE_ANNOTATION", bool, False, "ignore user sharding annotations"),
+    ("AUX_AFFINITY", bool, True, "variable<->optimizer-state affinity terms in ILP"),
+    ("COST_FACTOR", float, 1.0, "scale factor on comm costs"),
+    ("FP16_COMM", bool, False, "compress gradient all-reduce to bf16 [tpu: bf16]"),
+    ("NUM_GRADIENTS", int, -1, "override detected gradient count"),
+    ("FORWARD_SUB_GRAPH_NUM", int, -1, "cap on planner subgraph count"),
+    ("VAR_MEM_LIMIT", int, -1, "per-device variable bytes before ZeRO splitting"),
+    ("OPT_LEVEL", int, 2, "planner effort: 0 rule, 1 config, 2 exploration"),
+    ("UNBALANCED_RATIO", float, 8.0, "pipeline stage flops imbalance tolerance"),
+    ("NUM_MICRO_BATCHES", int, -1, "fixed micro-batch count (config mode)"),
+    ("NUM_STAGES", int, -1, "fixed pipeline stage count (config mode)"),
+    ("MICRO_NUM_LIMIT", int, 2, "max in-flight micro-batches (1F1B window)"),
+    ("GROUP_SCHED_COUNT", int, 3, "candidate schedules tried by TaskScheduler"),
+    ("PP_BANDWIDTH", float, 16.0, "pipeline xfer bandwidth GB/s (DCN override)"),
+    ("ILP_TIME_LIMIT", float, 5.0, "ILP solver time limit (s)"),
+    ("ILP_NUM_THREADS", int, 0, "ILP solver threads (0 = solver default)"),
+    ("FAKE_INPUT", bool, False, "reuse first batch forever (benchmark mode)"),
+    ("FRONTEND", str, "JAX", "client frontend identifier"),
+    ("FETCH_RESOURCE_VAR_STEPS", int, 0, "fetch vars to client every N steps"),
+    # --- TPU-native knobs -------------------------------------------------
+    ("TPU_GENERATION", str, "v5e", "[tpu] chip generation for the cost model"),
+    ("ICI_BANDWIDTH", float, -1.0, "[tpu] override ICI GB/s per link"),
+    ("DCN_BANDWIDTH", float, -1.0, "[tpu] override DCN GB/s per host"),
+    ("REMAT_POLICY", str, "none", "[tpu] jax.checkpoint policy for stages"),
+    ("DONATE_ARGS", bool, True, "[tpu] donate variable buffers into the step"),
+]
+
+_CONFIG_FILE_ENV = "TEPDIST_CONFIG"
+_DEFAULT_CONFIG_FILE = "config.json"
+
+
+def _parse(ty: type, raw: Any) -> Any:
+    if ty is bool:
+        if isinstance(raw, bool):
+            return raw
+        return str(raw).strip().lower() in ("1", "true", "yes", "on")
+    return ty(raw)
+
+
+class ServiceEnv:
+    """Process-wide config singleton. ``ServiceEnv.get().ilp_time_limit`` etc.
+    (lower-cased knob names become attributes)."""
+
+    _instance: Optional["ServiceEnv"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None):
+        self._values: Dict[str, Any] = {}
+        file_cfg = self._load_config_file()
+        for name, ty, default, _help in _ENV_LIST:
+            if name in os.environ:
+                val = _parse(ty, os.environ[name])
+            elif name in file_cfg:
+                val = _parse(ty, file_cfg[name])
+            else:
+                val = default
+            self._values[name] = val
+        for k, v in (overrides or {}).items():
+            self.set(k, v)
+
+    @staticmethod
+    def _load_config_file() -> Dict[str, Any]:
+        path = os.environ.get(_CONFIG_FILE_ENV, _DEFAULT_CONFIG_FILE)
+        try:
+            with open(path) as f:
+                cfg = json.load(f)
+            return cfg if isinstance(cfg, dict) else {}
+        except (OSError, json.JSONDecodeError):
+            return {}
+
+    @classmethod
+    def get(cls) -> "ServiceEnv":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls, overrides: Optional[Dict[str, Any]] = None) -> "ServiceEnv":
+        with cls._lock:
+            cls._instance = cls(overrides)
+            return cls._instance
+
+    def set(self, name: str, value: Any) -> None:
+        name = name.upper()
+        for n, ty, _d, _h in _ENV_LIST:
+            if n == name:
+                self._values[name] = _parse(ty, value)
+                return
+        raise KeyError(f"unknown knob {name}")
+
+    def __getattr__(self, name: str) -> Any:
+        values = object.__getattribute__(self, "_values")
+        key = name.upper()
+        if key in values:
+            return values[key]
+        raise AttributeError(name)
+
+    @staticmethod
+    def knobs() -> List[Tuple[str, type, Any, str]]:
+        return list(_ENV_LIST)

@@ -1,0 +1,88 @@
+"""DistSpec / DimStrategy / TensorStrategy unit tests.
+
+Mirrors the reference's dist_spec_test.cc (proto round-trip) plus
+PartitionSpec lowering checks specific to the TPU build."""
+
+from jax.sharding import PartitionSpec
+
+from tepdist_tpu.core.dist_spec import (
+    REPLICATED,
+    DimDistSpec,
+    DimStrategy,
+    DistSpec,
+    TensorStrategy,
+)
+
+
+def test_dim_strategy_states():
+    g = DimStrategy.glue()
+    assert g.is_glue() and not g.is_split()
+    r = DimStrategy.make_replicated(4)
+    assert not r.is_glue() and not r.is_split() and r.replicated
+    s = DimStrategy.split_on(1, 8)
+    assert s.is_split() and s.partition_dim == 1 and s.num_splits == 8
+    p = DimStrategy.make_partial(4)
+    assert p.partial and not p.is_split()
+
+
+def test_dist_spec_round_trip():
+    spec = DistSpec(
+        dims=[
+            DimDistSpec(partition_dim=0, num_splits=2),
+            DimDistSpec(partition_dim=REPLICATED, num_splits=4, partial=True),
+        ],
+        stage=3,
+    )
+    back = DistSpec.from_dict(spec.to_dict())
+    assert back == spec
+    assert back.stage == 3
+    assert back.has_partial()
+    assert not back.is_replicated()
+
+
+def test_dist_spec_partition_spec_lowering():
+    spec = DistSpec(
+        dims=[
+            DimDistSpec(partition_dim=0, num_splits=2),
+            DimDistSpec(partition_dim=2, num_splits=4),
+        ]
+    )
+    ps = spec.partition_spec(["data", "model"], ndim=3)
+    assert ps == PartitionSpec("data", None, "model")
+
+
+def test_tensor_strategy_partition_spec():
+    ts = TensorStrategy(
+        {
+            "data": DimStrategy.split_on(0, 2),
+            "model": DimStrategy.split_on(2, 4),
+        }
+    )
+    assert ts.partition_spec(3) == PartitionSpec("data", None, "model")
+    # Two axes on the same dim -> tuple entry.
+    ts2 = TensorStrategy(
+        {
+            "data": DimStrategy.split_on(0, 2),
+            "model": DimStrategy.split_on(0, 4),
+        }
+    )
+    assert ts2.partition_spec(2) == PartitionSpec(("data", "model"))
+    # Replicated/partial contribute nothing to the PartitionSpec.
+    ts3 = TensorStrategy({"model": DimStrategy.make_partial(4)})
+    assert ts3.partition_spec(2) == PartitionSpec()
+    assert ts3.has_partial() and ts3.partial_axes() == ["model"]
+
+
+def test_tensor_strategy_round_trip_via_dist_spec():
+    ts = TensorStrategy(
+        {
+            "data": DimStrategy.split_on(1, 2),
+            "model": DimStrategy.make_partial(4),
+        }
+    )
+    spec = ts.to_dist_spec(["data", "model"], stage=1)
+    assert spec.get(0).partition_dim == 1
+    assert spec.get(1).partial
+    assert spec.stage == 1
+    assert spec.get(0).to_strategy().is_split()
+    assert spec.get(1).to_strategy().partial

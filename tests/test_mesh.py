@@ -1,0 +1,106 @@
+"""MeshTopology / SplitId addressing tests (reference: dev_id_util tests via
+usage in task-graph; we test the addressing math directly)."""
+
+import pytest
+
+from tepdist_tpu.core.mesh import MeshTopology, SplitId
+
+
+def test_basic_sizes():
+    topo = MeshTopology([("stage", 2), ("model", 4)])
+    assert topo.num_devices == 8
+    assert topo.num_instances == 8
+    assert topo.size_of("model") == 4
+
+
+def test_shared_ordinal_consumes_no_devices():
+    # micro-batch ordinal is time, not devices (share_dev_flags=true in ref).
+    topo = MeshTopology(
+        [("micro", 4), ("stage", 2), ("model", 4)],
+        share_dev_flags=[True, False, False],
+        stage_split_ordinal=1,
+    )
+    assert topo.num_devices == 8
+    assert topo.num_instances == 32
+    assert topo.device_axes() == [("stage", 2), ("model", 4)]
+
+
+def test_device_id_round_trip():
+    topo = MeshTopology([("stage", 2), ("model", 4)])
+    seen = set()
+    for sid in topo.all_split_ids():
+        dev = topo.device_id(sid)
+        assert 0 <= dev < 8
+        seen.add(dev)
+        assert topo.split_id_for_device(dev) == sid
+    assert len(seen) == 8
+
+
+def test_placement_layout_permutes_linearization():
+    # Default: stage is slowest-varying. With layout [1, 0], model becomes
+    # slowest-varying: device id = model * 2 + stage.
+    topo = MeshTopology([("stage", 2), ("model", 4)], placement_layout=[1, 0])
+    sid = SplitId((1, 3))
+    assert topo.device_id(sid) == 3 * 2 + 1
+
+
+def test_dev_groups_are_collective_groups():
+    topo = MeshTopology([("data", 2), ("model", 4)])
+    model_groups = topo.dev_groups("model")
+    assert len(model_groups) == 2
+    assert all(len(g) == 4 for g in model_groups)
+    data_groups = topo.dev_groups("data")
+    assert len(data_groups) == 4
+    assert all(len(g) == 2 for g in data_groups)
+    # Every device appears exactly once per axis grouping.
+    flat = sorted(d for g in model_groups for d in g)
+    assert flat == list(range(8))
+
+
+def test_shared_ordinal_groups_rejected():
+    topo = MeshTopology([("micro", 4), ("model", 2)], share_dev_flags=[True, False])
+    with pytest.raises(ValueError):
+        topo.dev_groups("micro")
+
+
+def test_to_jax_mesh(devices):
+    topo = MeshTopology([("data", 2), ("model", 4)])
+    mesh = topo.to_jax_mesh(devices)
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.devices.shape == (2, 4)
+    # placement_layout=[1,0]: data varies fastest -> transposed device grid.
+    topo2 = MeshTopology([("data", 2), ("model", 4)], placement_layout=[1, 0])
+    mesh2 = topo2.to_jax_mesh(devices)
+    assert mesh2.devices.shape == (2, 4)
+    # In mesh2, walking along data axis steps by 1 in linear device order.
+    assert mesh2.devices[0, 0].id + 1 == mesh2.devices[1, 0].id
+
+
+def test_shared_ordinals_skipped_in_jax_mesh(devices):
+    topo = MeshTopology(
+        [("micro", 8), ("stage", 2), ("model", 4)],
+        share_dev_flags=[True, False, False],
+        stage_split_ordinal=1,
+    )
+    mesh = topo.to_jax_mesh(devices)
+    assert mesh.axis_names == ("stage", "model")
+    assert mesh.devices.shape == (2, 4)
+
+
+def test_service_env_knobs():
+    from tepdist_tpu.core.service_env import ServiceEnv
+
+    env = ServiceEnv.reset()
+    assert env.ilp_time_limit == 5.0
+    assert env.micro_num_limit == 2
+    env.set("NUM_STAGES", "4")
+    assert env.num_stages == 4
+    import os
+
+    os.environ["UNBALANCED_RATIO"] = "2.5"
+    try:
+        env2 = ServiceEnv.reset()
+        assert env2.unbalanced_ratio == 2.5
+    finally:
+        del os.environ["UNBALANCED_RATIO"]
+        ServiceEnv.reset()
