@@ -1,0 +1,3 @@
+from tepdist_tpu.graph.jaxpr_graph import GraphNode, JaxprGraph, trace_graph
+
+__all__ = ["GraphNode", "JaxprGraph", "trace_graph"]

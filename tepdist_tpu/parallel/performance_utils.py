@@ -1,0 +1,133 @@
+"""TPU hardware database + analytic collective/compute cost model.
+
+Reference parity: ``PerfUtils::{CalculateFlops, AllReduceCost, AllToAllCost,
+AllGatherCost}`` (reference: service/parallel/performance_utils.{h,cc}) and the
+V100/NVLink constants in ``Evaluator`` (parallel/evaluator.h:52-56). Here the
+constants are per-TPU-generation (MXU TFLOPS, HBM GB/s, ICI GB/s per link,
+DCN), and the collective formulas are the standard alpha-beta ring costs over
+ICI — what XLA actually emits on TPU meshes.
+
+Numbers are from public spec sheets / the public scaling literature
+(jax-ml.github.io/scaling-book); they feed a *relative* cost model, so small
+inaccuracies only matter if they flip a planning decision.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+from tepdist_tpu.core.service_env import ServiceEnv
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuChipSpec:
+    name: str
+    bf16_tflops: float          # peak MXU bf16 TFLOP/s per chip
+    hbm_gb: float               # HBM capacity per chip
+    hbm_gbps: float             # HBM bandwidth GB/s
+    ici_gbps_per_link: float    # unidirectional ICI bandwidth per link, GB/s
+    ici_links: int              # ICI links per chip (torus degree)
+    dcn_gbps: float             # per-host DCN bandwidth, GB/s
+
+
+# Public TPU spec-sheet numbers.
+TPU_CHIPS: Dict[str, TpuChipSpec] = {
+    "v4": TpuChipSpec("v4", 275.0, 32.0, 1228.0, 50.0, 6, 25.0),
+    "v5e": TpuChipSpec("v5e", 197.0, 16.0, 819.0, 50.0, 4, 25.0),
+    "v5p": TpuChipSpec("v5p", 459.0, 95.0, 2765.0, 100.0, 6, 25.0),
+    "v6e": TpuChipSpec("v6e", 918.0, 32.0, 1640.0, 100.0, 4, 25.0),
+    # Virtual CPU target used by the test harness; tiny numbers keep the
+    # planner's relative decisions realistic while making tests deterministic.
+    "cpu": TpuChipSpec("cpu", 0.1, 8.0, 50.0, 1.0, 2, 1.0),
+}
+
+
+def chip_spec(generation: str | None = None) -> TpuChipSpec:
+    gen = generation or ServiceEnv.get().tpu_generation
+    spec = TPU_CHIPS.get(gen.lower())
+    if spec is None:
+        raise KeyError(f"unknown TPU generation {gen!r}; known: {list(TPU_CHIPS)}")
+    env = ServiceEnv.get()
+    if env.ici_bandwidth > 0 or env.dcn_bandwidth > 0:
+        spec = dataclasses.replace(
+            spec,
+            ici_gbps_per_link=(env.ici_bandwidth if env.ici_bandwidth > 0
+                               else spec.ici_gbps_per_link),
+            dcn_gbps=(env.dcn_bandwidth if env.dcn_bandwidth > 0
+                      else spec.dcn_gbps),
+        )
+    return spec
+
+
+GB = 1e9
+# Fixed per-collective launch latency (the "alpha" term), seconds. ICI hops
+# are ~1us; XLA fuses/overlaps, so a small constant suffices for ranking.
+ALPHA_S = 2e-6
+
+
+class PerfUtils:
+    """Alpha-beta ring-cost formulas over an ICI axis of ``n`` chips.
+
+    All costs in seconds for ``bytes_`` payload per participating chip. The
+    ring formulas match what XLA emits for 1D ICI axes: reduce-scatter +
+    all-gather for all-reduce, neighbor exchanges for all-to-all.
+    """
+
+    @staticmethod
+    def _bw(spec: TpuChipSpec, over_dcn: bool) -> float:
+        # Bidirectional ring: 2 links usable per axis direction on a torus.
+        return (spec.dcn_gbps if over_dcn else 2.0 * spec.ici_gbps_per_link) * GB
+
+    @classmethod
+    def all_reduce_cost(cls, bytes_: float, n: int, spec: TpuChipSpec | None = None,
+                        over_dcn: bool = False) -> float:
+        if n <= 1:
+            return 0.0
+        spec = spec or chip_spec()
+        bw = cls._bw(spec, over_dcn)
+        return ALPHA_S * (n - 1) + 2.0 * bytes_ * (n - 1) / (n * bw)
+
+    @classmethod
+    def all_gather_cost(cls, bytes_: float, n: int, spec: TpuChipSpec | None = None,
+                        over_dcn: bool = False) -> float:
+        """``bytes_`` = full (gathered) size."""
+        if n <= 1:
+            return 0.0
+        spec = spec or chip_spec()
+        bw = cls._bw(spec, over_dcn)
+        return ALPHA_S * (n - 1) + bytes_ * (n - 1) / (n * bw)
+
+    reduce_scatter_cost = all_gather_cost  # identical ring cost shape
+
+    @classmethod
+    def all_to_all_cost(cls, bytes_: float, n: int, spec: TpuChipSpec | None = None,
+                        over_dcn: bool = False) -> float:
+        """``bytes_`` = per-chip resident size; each chip keeps 1/n, sends the
+        rest. On a bidirectional ring the bisection limits throughput to
+        ~bytes*(n/4)/bw; use the exact ring formula bytes*(n^2-1)/(4n)/bw
+        ~= bytes*n/4 for large n."""
+        if n <= 1:
+            return 0.0
+        spec = spec or chip_spec()
+        bw = cls._bw(spec, over_dcn)
+        return ALPHA_S * (n - 1) + bytes_ * (n * n - 1) / (4.0 * n * bw)
+
+    @classmethod
+    def ppermute_cost(cls, bytes_: float, spec: TpuChipSpec | None = None,
+                      over_dcn: bool = False) -> float:
+        """One neighbor hop (ring attention / pipeline send-recv)."""
+        spec = spec or chip_spec()
+        return ALPHA_S + bytes_ / (spec.ici_gbps_per_link * GB if not over_dcn
+                                   else spec.dcn_gbps * GB)
+
+    @classmethod
+    def compute_time(cls, flops: float, spec: TpuChipSpec | None = None,
+                     mxu_util: float = 0.5) -> float:
+        spec = spec or chip_spec()
+        return flops / (spec.bf16_tflops * 1e12 * mxu_util)
+
+    @classmethod
+    def hbm_time(cls, bytes_: float, spec: TpuChipSpec | None = None) -> float:
+        spec = spec or chip_spec()
+        return bytes_ / (spec.hbm_gbps * GB)

@@ -1,0 +1,93 @@
+"""JaxprGraph / cost model tests."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tepdist_tpu.graph.jaxpr_graph import JaxprGraph, trace_graph
+from tepdist_tpu.parallel.performance_utils import PerfUtils, chip_spec
+
+
+def _mlp_loss(params, x, y):
+    h = jax.nn.relu(x @ params["w1"] + params["b1"])
+    logits = h @ params["w2"] + params["b2"]
+    return jnp.mean((logits - y) ** 2)
+
+
+def _mlp_args(batch=16, din=32, dh=64, dout=8):
+    k = jax.random.PRNGKey(0)
+    params = {
+        "w1": jnp.zeros((din, dh)),
+        "b1": jnp.zeros((dh,)),
+        "w2": jnp.zeros((dh, dout)),
+        "b2": jnp.zeros((dout,)),
+    }
+    x = jax.random.normal(k, (batch, din))
+    y = jnp.zeros((batch, dout))
+    return params, x, y
+
+
+def test_trace_and_inline_flattens_calls():
+    params, x, y = _mlp_args()
+    grad_fn = jax.grad(_mlp_loss)
+    graph, _, _ = trace_graph(grad_fn, params, x, y)
+    prims = {n.prim for n in graph.nodes}
+    # relu's custom_jvp_call + nested jit must be inlined away.
+    assert "custom_jvp_call" not in prims
+    assert "pjit" not in prims and "jit" not in prims
+    assert "dot_general" in prims
+
+
+def test_dot_flops():
+    def f(a, b):
+        return a @ b
+
+    graph, _, _ = trace_graph(f, jnp.zeros((64, 32)), jnp.zeros((32, 16)))
+    dots = [n for n in graph.nodes if n.prim == "dot_general"]
+    assert len(dots) == 1
+    assert dots[0].flops == 2 * 64 * 32 * 16
+    assert dots[0].is_compute_intensive()
+
+
+def test_adjacency_and_ranks():
+    params, x, y = _mlp_args()
+    graph, _, _ = trace_graph(jax.grad(_mlp_loss), params, x, y)
+    # Forward dots must precede backward dots in asap rank.
+    dots = [n for n in graph.nodes if n.prim == "dot_general"]
+    assert len(dots) >= 4  # 2 fwd + >=2 bwd
+    for n in graph.nodes:
+        for u in n.users:
+            assert n in u.operands
+            assert u.asap > n.asap
+            assert u.alap > n.alap
+    # grads flow from inputs: every invar consumed somewhere
+    consumed = sum(1 for v in graph.invars if graph.arg_consumers(v))
+    assert consumed >= 5
+
+
+def test_scan_flops_scale_with_length():
+    def f(x):
+        def body(c, _):
+            return c @ c, None
+
+        out, _ = jax.lax.scan(body, x, None, length=10)
+        return out
+
+    graph, _, _ = trace_graph(f, jnp.zeros((16, 16)))
+    scans = [n for n in graph.nodes if n.prim == "scan"]
+    assert len(scans) == 1
+    assert scans[0].flops == pytest.approx(10 * 2 * 16 * 16 * 16)
+
+
+def test_perf_utils_monotonic():
+    spec = chip_spec("v5e")
+    b = 256 * 1024 * 1024
+    ar8 = PerfUtils.all_reduce_cost(b, 8, spec)
+    ar2 = PerfUtils.all_reduce_cost(b, 2, spec)
+    assert ar8 > ar2 > 0
+    ag = PerfUtils.all_gather_cost(b, 8, spec)
+    assert ag < ar8  # all-gather moves half the bytes of all-reduce
+    dcn = PerfUtils.all_reduce_cost(b, 8, spec, over_dcn=True)
+    assert dcn > ar8  # DCN much slower than ICI
+    assert PerfUtils.all_reduce_cost(b, 1, spec) == 0.0
+    assert PerfUtils.compute_time(1e12, spec) > 0
