@@ -1,0 +1,631 @@
+"""Per-primitive forward/backward DimStrategy transfer functions.
+
+Reference parity: ``StrategyUtil``'s ``Infer*`` / ``BackInfer*`` per-opcode
+propagation and the ``GenSplitProposals`` / ``GenDotProposals`` /
+``GenConvProposals`` generators (reference: service/parallel/utils.{h,cc},
+~3.2k LoC). The TPU build operates on jaxpr equations instead of HLO
+instructions, which shrinks the rule set: jaxprs make broadcasting explicit
+(``broadcast_in_dim``), so elementwise ops always see equal shapes.
+
+All rules reason about ONE mesh axis at a time ("split ordinal"), exactly like
+the reference — multi-axis plans are built by running the planner once per
+axis on the already-annotated graph.
+
+Core abstraction: most primitives are *dim-mapping* ops — each operand dim
+either maps to an output dim or disappears. Forward/backward inference then
+reduces to map application/inversion. ``dot_general``, ``conv``, ``reduce``
+get bespoke rules (partial-sum semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from jax.extend import core as jexcore
+
+from tepdist_tpu.core.dist_spec import DimStrategy
+
+Var = jexcore.Var
+Literal = jexcore.Literal
+
+
+@dataclasses.dataclass
+class InferResult:
+    """A consistent one-axis assignment for every operand and output of an
+    equation. ``in_strategies[i] is None`` means operand i is a literal/scalar
+    that needs no strategy."""
+
+    in_strategies: List[Optional[DimStrategy]]
+    out_strategies: List[DimStrategy]
+    # Communication this assignment implies on the *output* (e.g. partial →
+    # psum later). Purely informational; cost comes from performance_utils.
+    partial_output: bool = False
+
+
+# --------------------------------------------------------------------------
+# Elementwise primitive sets
+# --------------------------------------------------------------------------
+
+ELEMENTWISE = {
+    "add", "sub", "mul", "div", "pow", "max", "min", "rem", "atan2",
+    "and", "or", "xor", "not", "neg", "sign", "floor", "ceil", "round",
+    "exp", "exp2", "log", "log1p", "expm1", "tanh", "sin", "cos", "tan",
+    "asin", "acos", "atan", "sinh", "cosh", "asinh", "acosh", "atanh",
+    "sqrt", "rsqrt", "cbrt", "logistic", "erf", "erfc", "erf_inv",
+    "is_finite", "abs", "square", "integer_pow", "clamp", "select_n",
+    "eq", "ne", "ge", "gt", "le", "lt", "nextafter",
+    "convert_element_type", "bitcast_convert_type", "real", "imag",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "population_count", "clz", "copy", "stop_gradient", "reduce_precision",
+    "erf_inv", "random_gamma_grad", "digamma", "lgamma",
+}
+
+REDUCE_PARTIAL = {"reduce_sum", "reduce_prod"}  # split reduced dim -> partial
+REDUCE_NONLINEAR = {"reduce_max", "reduce_min", "reduce_and", "reduce_or",
+                    "argmax", "argmin"}
+
+# Primitives that produce fresh values with no operand coupling: any split of
+# the output is legal (each shard generates its slice). Includes RNG: JAX's
+# counter-based threefry under GSPMD generates shard-consistent slices.
+GENERATIVE = {"iota", "rng_bit_generator", "random_bits", "random_seed",
+              "random_wrap", "random_fold_in"}
+
+OPAQUE = {"scan", "while", "cond", "custom_primitive", "sort", "top_k",
+          "cumsum", "cumprod", "cummax", "cummin"}
+
+
+def _shape(atom) -> Tuple[int, ...]:
+    return tuple(getattr(atom.aval, "shape", ()))
+
+
+def _is_scalar(atom) -> bool:
+    return len(_shape(atom)) == 0
+
+
+def _divisible(shape: Tuple[int, ...], dim: int, n: int) -> bool:
+    return 0 <= dim < len(shape) and shape[dim] % n == 0 and shape[dim] >= n
+
+
+# --------------------------------------------------------------------------
+# Dim maps: operand_dim -> out_dim (single-output ops)
+# --------------------------------------------------------------------------
+
+def dim_maps(eqn) -> Optional[List[Dict[int, int]]]:
+    """Per-operand mapping operand_dim → output_dim for mapping-style ops.
+    Returns None if the primitive needs bespoke handling."""
+    name = eqn.primitive.name
+    out_shape = _shape(eqn.outvars[0])
+
+    if name in ELEMENTWISE:
+        maps = []
+        for a in eqn.invars:
+            s = _shape(a)
+            if len(s) == 0:
+                maps.append({})
+            elif s == out_shape:
+                maps.append({i: i for i in range(len(s))})
+            else:
+                return None  # unexpected implicit broadcast
+        return maps
+
+    if name == "transpose":
+        perm = eqn.params["permutation"]
+        return [{int(src): i for i, src in enumerate(perm)}]
+
+    if name == "broadcast_in_dim":
+        bcast = eqn.params["broadcast_dimensions"]
+        in_shape = _shape(eqn.invars[0])
+        m = {}
+        for i, od in enumerate(bcast):
+            if in_shape[i] == out_shape[od]:
+                m[i] = int(od)
+        return [m]
+
+    if name in ("squeeze",):
+        dims = set(eqn.params["dimensions"])
+        in_shape = _shape(eqn.invars[0])
+        m, o = {}, 0
+        for i in range(len(in_shape)):
+            if i in dims:
+                continue
+            m[i] = o
+            o += 1
+        return [m]
+
+    if name == "expand_dims":
+        dims = set(eqn.params["dimensions"])
+        m, i = {}, 0
+        for o in range(len(out_shape)):
+            if o in dims:
+                continue
+            m[i] = o
+            i += 1
+        return [m]
+
+    if name == "reshape":
+        return [_reshape_map(_shape(eqn.invars[0]), out_shape)]
+
+    if name == "rev":
+        dims = set(eqn.params["dimensions"])
+        in_shape = _shape(eqn.invars[0])
+        return [{i: i for i in range(len(in_shape)) if i not in dims}]
+
+    if name == "concatenate":
+        cdim = eqn.params["dimension"]
+        maps = []
+        for a in eqn.invars:
+            s = _shape(a)
+            maps.append({i: i for i in range(len(s)) if i != cdim})
+        return maps
+
+    if name in ("slice", "pad"):
+        # Dims left whole map through; sliced/padded dims don't.
+        in_shape = _shape(eqn.invars[0])
+        m = {}
+        for i in range(min(len(in_shape), len(out_shape))):
+            if in_shape[i] == out_shape[i]:
+                m[i] = i
+        return [m] + [{} for _ in eqn.invars[1:]]
+
+    if name in ("dynamic_slice",):
+        in_shape = _shape(eqn.invars[0])
+        m = {i: i for i in range(len(in_shape)) if i < len(out_shape)
+             and in_shape[i] == out_shape[i]}
+        return [m] + [{} for _ in eqn.invars[1:]]
+
+    if name in ("dynamic_update_slice",):
+        in_shape = _shape(eqn.invars[0])
+        upd_shape = _shape(eqn.invars[1])
+        m0 = {i: i for i in range(len(in_shape))}
+        m1 = {i: i for i in range(len(upd_shape))
+              if i < len(in_shape) and upd_shape[i] == in_shape[i]}
+        # operand 0 dims map identically, but a split dim must not intersect
+        # a partially-updated dim; conservatively require updated dims whole.
+        for i in range(len(upd_shape)):
+            if upd_shape[i] != in_shape[i]:
+                m0.pop(i, None)
+                m1.pop(i, None)
+        return [m0, m1] + [{} for _ in eqn.invars[2:]]
+
+    return None
+
+
+def _reshape_map(src: Tuple[int, ...], dst: Tuple[int, ...]) -> Dict[int, int]:
+    """Map src dims to dst dims when a src dim corresponds exactly to one dst
+    dim (same size, aligned element strides) — the safe subset of reshape."""
+    m: Dict[int, int] = {}
+    i = j = 0
+    si = dj = 1
+    # Walk both shapes aligning cumulative products.
+    while i < len(src) and j < len(dst):
+        a, b = src[i], dst[j]
+        if si == dj and a == b:
+            m[i] = j
+            i += 1
+            j += 1
+        elif si * a < dj * b:
+            si *= a
+            i += 1
+        elif si * a > dj * b:
+            dj *= b
+            j += 1
+        else:
+            si *= a
+            dj *= b
+            i += 1
+            j += 1
+    return m
+
+
+# --------------------------------------------------------------------------
+# dot_general helpers
+# --------------------------------------------------------------------------
+
+def dot_dims(eqn):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs_shape = _shape(eqn.invars[0])
+    rhs_shape = _shape(eqn.invars[1])
+    lhs_free = [d for d in range(len(lhs_shape)) if d not in lc and d not in lb]
+    rhs_free = [d for d in range(len(rhs_shape)) if d not in rc and d not in rb]
+    # Output layout: batch dims, then lhs free, then rhs free.
+    out_of_lhs = {}
+    out_of_rhs = {}
+    for k, (ld, rd) in enumerate(zip(lb, rb)):
+        out_of_lhs[ld] = k
+        out_of_rhs[rd] = k
+    for n, d in enumerate(lhs_free):
+        out_of_lhs[d] = len(lb) + n
+    for n, d in enumerate(rhs_free):
+        out_of_rhs[d] = len(lb) + len(lhs_free) + n
+    return {
+        "lc": list(lc), "rc": list(rc), "lb": list(lb), "rb": list(rb),
+        "lhs_free": lhs_free, "rhs_free": rhs_free,
+        "out_of_lhs": out_of_lhs, "out_of_rhs": out_of_rhs,
+    }
+
+
+# --------------------------------------------------------------------------
+# StrategyUtil
+# --------------------------------------------------------------------------
+
+class StrategyUtil:
+    """One-mesh-axis strategy inference over jaxpr equations."""
+
+    # ---- forward --------------------------------------------------------
+    @staticmethod
+    def forward_infer(eqn, known: Dict[int, DimStrategy], num_splits: int
+                      ) -> Optional[InferResult]:
+        """Given concrete strategies for a subset of operands (``known``:
+        operand index → strategy), complete a consistent assignment or return
+        None (meaning: a reshard would be required to use this op this way).
+        Replicated inputs propagate to replicated outputs."""
+        name = eqn.primitive.name
+        n_in = len(eqn.invars)
+        n_out = len(eqn.outvars)
+
+        def all_replicated() -> InferResult:
+            rep = DimStrategy.make_replicated(num_splits)
+            return InferResult(
+                in_strategies=[None if _is_scalar(a) else rep for a in eqn.invars],
+                out_strategies=[rep] * n_out,
+            )
+
+        # Anything opaque: only replicated flows through.
+        if name in OPAQUE:
+            if all(s.replicated or s.is_glue() for s in known.values()):
+                return all_replicated()
+            return None
+
+        if name in GENERATIVE:
+            return all_replicated()
+
+        # No information: replicate.
+        split_known = {i: s for i, s in known.items() if s.is_split() or s.partial}
+        if not split_known:
+            return all_replicated()
+
+        if any(s.partial for s in known.values()):
+            # Partial operands must be resolved (psum) before reuse except in
+            # linear ops where partial-ness propagates: add with replicated 0
+            # etc. Keep v1 conservative: propagate through pure adds only.
+            if name == "add":
+                out = DimStrategy.make_partial(num_splits)
+                return InferResult(
+                    in_strategies=[known.get(i, DimStrategy.make_partial(num_splits))
+                                   for i in range(n_in)],
+                    out_strategies=[out],
+                    partial_output=True,
+                )
+            return None
+
+        if name == "dot_general":
+            return StrategyUtil._forward_dot(eqn, split_known, num_splits)
+        if name == "conv_general_dilated":
+            return StrategyUtil._forward_conv(eqn, split_known, num_splits)
+        if name in REDUCE_PARTIAL or name in REDUCE_NONLINEAR:
+            return StrategyUtil._forward_reduce(eqn, split_known, num_splits)
+
+        maps = dim_maps(eqn)
+        if maps is None:
+            return None
+        # Determine the output dim implied by each known split operand.
+        out_dim = None
+        for i, s in split_known.items():
+            m = maps[i]
+            if s.partition_dim not in m:
+                return None
+            od = m[s.partition_dim]
+            if out_dim is None:
+                out_dim = od
+            elif out_dim != od:
+                return None
+        assert out_dim is not None
+        out_shape = _shape(eqn.outvars[0])
+        if not _divisible(out_shape, out_dim, num_splits):
+            return None
+        out_s = DimStrategy.split_on(out_dim, num_splits)
+        in_strategies: List[Optional[DimStrategy]] = []
+        for i, a in enumerate(eqn.invars):
+            if _is_scalar(a) or isinstance(a, Literal):
+                in_strategies.append(None)
+                continue
+            inv = {v: k for k, v in maps[i].items()}
+            if out_dim in inv:
+                d = inv[out_dim]
+                if not _divisible(_shape(a), d, num_splits):
+                    return None
+                in_strategies.append(DimStrategy.split_on(d, num_splits))
+            else:
+                # Operand lacks the split dim (e.g. broadcast input, slice
+                # start index): must be replicated.
+                in_strategies.append(DimStrategy.make_replicated(num_splits))
+        # Known strategies must match what we derived.
+        for i, s in known.items():
+            if in_strategies[i] is not None and s.is_split():
+                if in_strategies[i].partition_dim != s.partition_dim:
+                    return None
+        return InferResult(in_strategies=in_strategies,
+                           out_strategies=[out_s] * n_out)
+
+    @staticmethod
+    def _forward_dot(eqn, known, num_splits) -> Optional[InferResult]:
+        d = dot_dims(eqn)
+        lhs, rhs = eqn.invars[0], eqn.invars[1]
+        out_shape = _shape(eqn.outvars[0])
+        ls = known.get(0)
+        rs = known.get(1)
+
+        def res(l, r, o, partial=False):
+            return InferResult(in_strategies=[l, r], out_strategies=[o],
+                               partial_output=partial)
+
+        rep = DimStrategy.make_replicated(num_splits)
+
+        if ls is not None and ls.is_split():
+            pd = ls.partition_dim
+            if pd in d["lb"]:
+                k = d["lb"].index(pd)
+                rd = d["rb"][k]
+                if rs is not None and rs.is_split() and rs.partition_dim != rd:
+                    return None
+                if not _divisible(_shape(rhs), rd, num_splits):
+                    return None
+                return res(ls, DimStrategy.split_on(rd, num_splits),
+                           DimStrategy.split_on(k, num_splits))
+            if pd in d["lc"]:
+                k = d["lc"].index(pd)
+                rd = d["rc"][k]
+                if rs is not None and rs.is_split() and rs.partition_dim != rd:
+                    return None
+                if not _divisible(_shape(rhs), rd, num_splits):
+                    return None
+                return res(ls, DimStrategy.split_on(rd, num_splits),
+                           DimStrategy.make_partial(num_splits), partial=True)
+            # lhs free dim
+            if rs is not None and rs.is_split():
+                # both free: 2D output tiling needs two axes; on one axis -> conflict
+                return None
+            od = d["out_of_lhs"][pd]
+            if not _divisible(out_shape, od, num_splits):
+                return None
+            return res(ls, rep, DimStrategy.split_on(od, num_splits))
+
+        if rs is not None and rs.is_split():
+            pd = rs.partition_dim
+            if pd in d["rb"]:
+                k = d["rb"].index(pd)
+                ld = d["lb"][k]
+                if not _divisible(_shape(lhs), ld, num_splits):
+                    return None
+                return res(DimStrategy.split_on(ld, num_splits), rs,
+                           DimStrategy.split_on(k, num_splits))
+            if pd in d["rc"]:
+                k = d["rc"].index(pd)
+                ld = d["lc"][k]
+                if not _divisible(_shape(lhs), ld, num_splits):
+                    return None
+                return res(DimStrategy.split_on(ld, num_splits), rs,
+                           DimStrategy.make_partial(num_splits), partial=True)
+            od = d["out_of_rhs"][pd]
+            if not _divisible(out_shape, od, num_splits):
+                return None
+            return res(rep, rs, DimStrategy.split_on(od, num_splits))
+
+        return None
+
+    @staticmethod
+    def _forward_conv(eqn, known, num_splits) -> Optional[InferResult]:
+        dnums = eqn.params["dimension_numbers"]
+        lhs_shape = _shape(eqn.invars[0])
+        rhs_shape = _shape(eqn.invars[1])
+        out_shape = _shape(eqn.outvars[0])
+        rep = DimStrategy.make_replicated(num_splits)
+        ls, rs = known.get(0), known.get(1)
+
+        lhs_batch = dnums.lhs_spec[0]
+        lhs_feat = dnums.lhs_spec[1]
+        rhs_ofeat = dnums.rhs_spec[0]
+        rhs_ifeat = dnums.rhs_spec[1]
+        out_batch = dnums.out_spec[0]
+        out_feat = dnums.out_spec[1]
+
+        if ls is not None and ls.is_split():
+            if ls.partition_dim == lhs_batch:
+                if rs is not None and rs.is_split():
+                    return None
+                if not _divisible(out_shape, out_batch, num_splits):
+                    return None
+                return InferResult([ls, rep],
+                                   [DimStrategy.split_on(out_batch, num_splits)])
+            if ls.partition_dim == lhs_feat:
+                need = DimStrategy.split_on(rhs_ifeat, num_splits)
+                if rs is not None and rs.is_split() and rs.partition_dim != rhs_ifeat:
+                    return None
+                if not _divisible(rhs_shape, rhs_ifeat, num_splits):
+                    return None
+                return InferResult([ls, need],
+                                   [DimStrategy.make_partial(num_splits)],
+                                   partial_output=True)
+            return None  # spatial split: needs halo exchange, not in v1
+        if rs is not None and rs.is_split():
+            if rs.partition_dim == rhs_ofeat:
+                if not _divisible(out_shape, out_feat, num_splits):
+                    return None
+                return InferResult([rep, rs],
+                                   [DimStrategy.split_on(out_feat, num_splits)])
+            if rs.partition_dim == rhs_ifeat:
+                if not _divisible(lhs_shape, lhs_feat, num_splits):
+                    return None
+                return InferResult([DimStrategy.split_on(lhs_feat, num_splits), rs],
+                                   [DimStrategy.make_partial(num_splits)],
+                                   partial_output=True)
+            return None
+        return None
+
+    @staticmethod
+    def _forward_reduce(eqn, known, num_splits) -> Optional[InferResult]:
+        name = eqn.primitive.name
+        axes = set(eqn.params.get("axes", ()))
+        in_shape = _shape(eqn.invars[0])
+        s = known.get(0)
+        if s is None or not s.is_split():
+            return None
+        pd = s.partition_dim
+        if pd in axes:
+            if name in REDUCE_PARTIAL:
+                return InferResult([s], [DimStrategy.make_partial(num_splits)]
+                                   * len(eqn.outvars), partial_output=True)
+            return None  # max/min over split dim needs a real collective
+        out_dim = pd - sum(1 for a in axes if a < pd)
+        out_shape = _shape(eqn.outvars[0])
+        if not _divisible(out_shape, out_dim, num_splits):
+            return None
+        return InferResult([s], [DimStrategy.split_on(out_dim, num_splits)]
+                           * len(eqn.outvars))
+
+    # ---- backward -------------------------------------------------------
+    @staticmethod
+    def back_infer(eqn, out_strategy: DimStrategy, num_splits: int
+                   ) -> Optional[InferResult]:
+        """Given the desired strategy of output 0, derive operand strategies.
+        Returns None when the output split can't be realized locally."""
+        name = eqn.primitive.name
+        if not out_strategy.is_split():
+            if out_strategy.replicated:
+                rep = DimStrategy.make_replicated(num_splits)
+                return InferResult(
+                    [None if _is_scalar(a) else rep for a in eqn.invars],
+                    [out_strategy] * len(eqn.outvars))
+            return None
+
+        if name in GENERATIVE:
+            return InferResult([None for _ in eqn.invars],
+                               [out_strategy] * len(eqn.outvars))
+
+        if name == "dot_general":
+            d = dot_dims(eqn)
+            od = out_strategy.partition_dim
+            inv_l = {v: k for k, v in d["out_of_lhs"].items()}
+            inv_r = {v: k for k, v in d["out_of_rhs"].items()}
+            rep = DimStrategy.make_replicated(num_splits)
+            in_l = in_r = None
+            if od in inv_l:
+                ld = inv_l[od]
+                if not _divisible(_shape(eqn.invars[0]), ld, num_splits):
+                    return None
+                in_l = DimStrategy.split_on(ld, num_splits)
+            if od in inv_r:
+                rd = inv_r[od]
+                if not _divisible(_shape(eqn.invars[1]), rd, num_splits):
+                    return None
+                in_r = DimStrategy.split_on(rd, num_splits)
+            if in_l is None and in_r is None:
+                return None
+            return InferResult([in_l or rep, in_r or rep],
+                               [out_strategy])
+
+        if name == "conv_general_dilated":
+            dnums = eqn.params["dimension_numbers"]
+            od = out_strategy.partition_dim
+            rep = DimStrategy.make_replicated(num_splits)
+            if od == dnums.out_spec[0]:  # batch
+                ld = dnums.lhs_spec[0]
+                if not _divisible(_shape(eqn.invars[0]), ld, num_splits):
+                    return None
+                return InferResult([DimStrategy.split_on(ld, num_splits), rep],
+                                   [out_strategy])
+            if od == dnums.out_spec[1]:  # feature
+                rd = dnums.rhs_spec[0]
+                if not _divisible(_shape(eqn.invars[1]), rd, num_splits):
+                    return None
+                return InferResult([rep, DimStrategy.split_on(rd, num_splits)],
+                                   [out_strategy])
+            return None
+
+        if name in REDUCE_PARTIAL or name in REDUCE_NONLINEAR:
+            axes = sorted(eqn.params.get("axes", ()))
+            od = out_strategy.partition_dim
+            pd = od
+            for a in axes:
+                if a <= pd:
+                    pd += 1
+            if not _divisible(_shape(eqn.invars[0]), pd, num_splits):
+                return None
+            return InferResult([DimStrategy.split_on(pd, num_splits)],
+                               [out_strategy] * len(eqn.outvars))
+
+        maps = dim_maps(eqn)
+        if maps is None:
+            return None
+        od = out_strategy.partition_dim
+        in_strategies: List[Optional[DimStrategy]] = []
+        rep = DimStrategy.make_replicated(num_splits)
+        ok = False
+        for i, a in enumerate(eqn.invars):
+            if _is_scalar(a) or isinstance(a, Literal):
+                in_strategies.append(None)
+                continue
+            inv = {v: k for k, v in maps[i].items()}
+            if od in inv:
+                d_in = inv[od]
+                if not _divisible(_shape(a), d_in, num_splits):
+                    return None
+                in_strategies.append(DimStrategy.split_on(d_in, num_splits))
+                ok = True
+            else:
+                in_strategies.append(rep)
+        if not ok:
+            return None
+        return InferResult(in_strategies, [out_strategy] * len(eqn.outvars))
+
+    # ---- proposal generation -------------------------------------------
+    @staticmethod
+    def gen_proposals(eqn, num_splits: int) -> List[InferResult]:
+        """Candidate one-axis strategies for a cone root (reference:
+        GenDotProposals/GenConvProposals/GenSplitProposals)."""
+        name = eqn.primitive.name
+        proposals: List[InferResult] = []
+        if name == "dot_general":
+            d = dot_dims(eqn)
+            lhs_shape = _shape(eqn.invars[0])
+            cands: List[DimStrategy] = []
+            for pd in d["lb"] + d["lhs_free"] + d["lc"]:
+                if _divisible(lhs_shape, pd, num_splits):
+                    cands.append(DimStrategy.split_on(pd, num_splits))
+            for s in cands:
+                r = StrategyUtil.forward_infer(eqn, {0: s}, num_splits)
+                if r is not None:
+                    proposals.append(r)
+            rhs_shape = _shape(eqn.invars[1])
+            for pd in d["rhs_free"]:
+                if _divisible(rhs_shape, pd, num_splits):
+                    r = StrategyUtil.forward_infer(
+                        eqn, {1: DimStrategy.split_on(pd, num_splits)}, num_splits)
+                    if r is not None:
+                        proposals.append(r)
+        elif name == "conv_general_dilated":
+            dnums = eqn.params["dimension_numbers"]
+            for op_idx, pd in ((0, dnums.lhs_spec[0]), (0, dnums.lhs_spec[1]),
+                               (1, dnums.rhs_spec[0])):
+                if _divisible(_shape(eqn.invars[op_idx]), pd, num_splits):
+                    r = StrategyUtil.forward_infer(
+                        eqn, {op_idx: DimStrategy.split_on(pd, num_splits)},
+                        num_splits)
+                    if r is not None:
+                        proposals.append(r)
+        else:
+            out_shape = _shape(eqn.outvars[0])
+            for od in range(len(out_shape)):
+                if _divisible(out_shape, od, num_splits):
+                    r = StrategyUtil.back_infer(
+                        eqn, DimStrategy.split_on(od, num_splits), num_splits)
+                    if r is not None:
+                        proposals.append(r)
+        # Always offer full replication as a fallback.
+        rep = DimStrategy.make_replicated(num_splits)
+        proposals.append(InferResult(
+            [None if _is_scalar(a) else rep for a in eqn.invars],
+            [rep] * len(eqn.outvars)))
+        return proposals

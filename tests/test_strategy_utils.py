@@ -1,0 +1,140 @@
+"""Strategy transfer-function tests (reference: StrategyUtil Infer*/BackInfer*)."""
+
+import jax
+import jax.numpy as jnp
+
+from tepdist_tpu.core.dist_spec import DimStrategy
+from tepdist_tpu.graph.jaxpr_graph import trace_graph
+from tepdist_tpu.parallel.strategy_utils import StrategyUtil, dot_dims
+
+
+def _eqn(fn, *args, prim=None, idx=0):
+    graph, _, _ = trace_graph(fn, *args)
+    if prim is None:
+        return graph.nodes[idx].eqn
+    matches = [n.eqn for n in graph.nodes if n.prim == prim]
+    return matches[idx]
+
+
+def test_dot_batch_split_is_dp():
+    # x:[B,K] @ w:[K,N] with x split on B -> out split on 0, w replicated.
+    eqn = _eqn(lambda x, w: x @ w, jnp.zeros((8, 4)), jnp.zeros((4, 6)),
+               prim="dot_general")
+    r = StrategyUtil.forward_infer(eqn, {0: DimStrategy.split_on(0, 2)}, 2)
+    assert r is not None and not r.partial_output
+    assert r.out_strategies[0].partition_dim == 0
+    assert r.in_strategies[1].replicated
+
+
+def test_dot_contraction_split_is_partial():
+    eqn = _eqn(lambda x, w: x @ w, jnp.zeros((8, 4)), jnp.zeros((4, 6)),
+               prim="dot_general")
+    r = StrategyUtil.forward_infer(eqn, {0: DimStrategy.split_on(1, 2)}, 2)
+    assert r is not None and r.partial_output
+    assert r.out_strategies[0].partial
+    assert r.in_strategies[1].partition_dim == 0  # w split on K
+
+
+def test_dot_rhs_free_split_is_tp():
+    eqn = _eqn(lambda x, w: x @ w, jnp.zeros((8, 4)), jnp.zeros((4, 6)),
+               prim="dot_general")
+    r = StrategyUtil.forward_infer(eqn, {1: DimStrategy.split_on(1, 2)}, 2)
+    assert r is not None
+    assert r.out_strategies[0].partition_dim == 1  # out [B, N/2]
+    assert r.in_strategies[0].replicated
+
+
+def test_dot_back_infer():
+    eqn = _eqn(lambda x, w: x @ w, jnp.zeros((8, 4)), jnp.zeros((4, 6)),
+               prim="dot_general")
+    r = StrategyUtil.back_infer(eqn, DimStrategy.split_on(1, 2), 2)
+    assert r is not None
+    assert r.in_strategies[0].replicated
+    assert r.in_strategies[1].partition_dim == 1
+
+
+def test_batched_dot_dims():
+    # [B,H,S,K] @ [B,H,K,T] batched matmul (attention shape).
+    eqn = _eqn(lambda a, b: jnp.einsum("bhsk,bhkt->bhst", a, b),
+               jnp.zeros((2, 4, 8, 16)), jnp.zeros((2, 4, 16, 8)),
+               prim="dot_general")
+    d = dot_dims(eqn)
+    assert d["lb"] == [0, 1] and d["rb"] == [0, 1]
+    r = StrategyUtil.forward_infer(eqn, {0: DimStrategy.split_on(1, 4)}, 4)
+    assert r is not None
+    assert r.in_strategies[1].partition_dim == 1  # rhs head dim
+    assert r.out_strategies[0].partition_dim == 1
+
+
+def test_elementwise_propagation():
+    eqn = _eqn(lambda a, b: a + b, jnp.zeros((8, 4)), jnp.zeros((8, 4)))
+    r = StrategyUtil.forward_infer(eqn, {0: DimStrategy.split_on(1, 2)}, 2)
+    assert r is not None
+    assert r.in_strategies[1].partition_dim == 1
+    assert r.out_strategies[0].partition_dim == 1
+
+
+def test_scalar_operand_needs_no_strategy():
+    eqn = _eqn(lambda a: a * 2.0, jnp.zeros((8, 4)))
+    r = StrategyUtil.forward_infer(eqn, {0: DimStrategy.split_on(0, 2)}, 2)
+    assert r is not None
+    assert r.out_strategies[0].partition_dim == 0
+
+
+def test_reduce_sum_over_split_dim_is_partial():
+    eqn = _eqn(lambda a: a.sum(axis=1), jnp.zeros((8, 4)), prim="reduce_sum")
+    r = StrategyUtil.forward_infer(eqn, {0: DimStrategy.split_on(1, 2)}, 2)
+    assert r is not None and r.partial_output
+
+    r2 = StrategyUtil.forward_infer(eqn, {0: DimStrategy.split_on(0, 2)}, 2)
+    assert r2 is not None and not r2.partial_output
+    assert r2.out_strategies[0].partition_dim == 0
+
+
+def test_reduce_max_over_split_dim_unsupported():
+    eqn = _eqn(lambda a: a.max(axis=0), jnp.zeros((8, 4)), prim="reduce_max")
+    assert StrategyUtil.forward_infer(eqn, {0: DimStrategy.split_on(0, 2)}, 2) is None
+
+
+def test_transpose_map():
+    eqn = _eqn(lambda a: a.T, jnp.zeros((8, 4)), prim="transpose")
+    r = StrategyUtil.forward_infer(eqn, {0: DimStrategy.split_on(0, 2)}, 2)
+    assert r.out_strategies[0].partition_dim == 1
+
+
+def test_reshape_preserved_dim():
+    eqn = _eqn(lambda a: a.reshape(8, 2, 2), jnp.zeros((8, 4)), prim="reshape")
+    r = StrategyUtil.forward_infer(eqn, {0: DimStrategy.split_on(0, 2)}, 2)
+    assert r is not None
+    assert r.out_strategies[0].partition_dim == 0
+    # Split dim 1 (size 4 -> folded into (2,2)): no clean mapping.
+    r2 = StrategyUtil.forward_infer(eqn, {0: DimStrategy.split_on(1, 2)}, 2)
+    assert r2 is None
+
+
+def test_broadcast_in_dim():
+    eqn = _eqn(lambda b: jnp.zeros((8, 4)) + b, jnp.zeros((4,)),
+               prim="broadcast_in_dim", idx=-1)
+    # find broadcast of the (4,) arg
+    graph_eqn = eqn
+    r = StrategyUtil.forward_infer(graph_eqn, {0: DimStrategy.split_on(0, 2)}, 2)
+    if r is not None:  # broadcast of arg: dim 0 -> dim 1
+        assert r.out_strategies[0].partition_dim in (0, 1)
+
+
+def test_divisibility_guard():
+    eqn = _eqn(lambda x, w: x @ w, jnp.zeros((7, 4)), jnp.zeros((4, 6)),
+               prim="dot_general")
+    assert StrategyUtil.forward_infer(eqn, {0: DimStrategy.split_on(0, 2)}, 2) is None
+
+
+def test_gen_proposals_dot():
+    eqn = _eqn(lambda x, w: x @ w, jnp.zeros((8, 4)), jnp.zeros((4, 6)),
+               prim="dot_general")
+    props = StrategyUtil.gen_proposals(eqn, 2)
+    # batch split, contraction split, rhs-N split, replicated fallback
+    assert len(props) >= 4
+    partials = [p for p in props if p.partial_output]
+    assert len(partials) == 1
+    replicated = [p for p in props if p.out_strategies[0].replicated]
+    assert len(replicated) == 1
