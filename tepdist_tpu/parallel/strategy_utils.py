@@ -576,6 +576,11 @@ class StrategyUtil:
                 ok = True
             else:
                 in_strategies.append(rep)
+        # broadcast_in_dim: an output dim absent from the operand map is a
+        # broadcast-created (or size-1 stretched) dim — every shard computes
+        # its slice locally from the replicated operand, no comm needed.
+        if not ok and name == "broadcast_in_dim":
+            return InferResult(in_strategies, [out_strategy] * len(eqn.outvars))
         if not ok:
             return None
         return InferResult(in_strategies, [out_strategy] * len(eqn.outvars))

@@ -1,0 +1,113 @@
+"""Cone/ILP SPMD planner tests: DP and TP must *emerge* from the cost model,
+not be hard-coded (reference: cost_spmd_strategy exploration behavior)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tepdist_tpu.core.dist_spec import DimStrategy
+from tepdist_tpu.graph.jaxpr_graph import trace_graph
+from tepdist_tpu.parallel.cost_spmd_strategy import (
+    CostSpmdStrategy,
+    transition_cost,
+)
+from tepdist_tpu.parallel.performance_utils import chip_spec
+
+
+def _mlp_grad_graph(batch=256, din=64, dh=128, dout=32):
+    def loss(params, x, y):
+        h = jax.nn.relu(x @ params["w1"])
+        logits = h @ params["w2"]
+        return jnp.mean((logits - y) ** 2)
+
+    f32 = jnp.float32
+    params = {
+        "w1": jax.ShapeDtypeStruct((din, dh), f32),
+        "w2": jax.ShapeDtypeStruct((dh, dout), f32),
+    }
+    x = jax.ShapeDtypeStruct((batch, din), f32)
+    y = jax.ShapeDtypeStruct((batch, dout), f32)
+    graph, _, _ = trace_graph(jax.grad(loss), params, x, y)
+    return graph, params
+
+
+def test_cones_cover_all_dots():
+    graph, _ = _mlp_grad_graph()
+    planner = CostSpmdStrategy(graph, "data", 8)
+    cones = planner._build_cones()
+    roots = {c.root.id for c in cones}
+    dots = {n.id for n in graph.nodes if n.prim == "dot_general"}
+    assert roots == dots
+    #
+
+def test_cone_strategies_enumerated():
+    graph, _ = _mlp_grad_graph()
+    planner = CostSpmdStrategy(graph, "data", 8)
+    cones = planner._build_cones()
+    planner._enumerate_cone_strategies(cones)
+    for c in cones:
+        assert len(c.strategies) >= 2  # at least one split + replicated
+
+
+def test_data_parallel_emerges_for_large_batch():
+    # batch >> weights: DP (batch split, weights replicated) must win.
+    # Shapes must be large enough that replicating compute costs more than
+    # the gradient all-reduce alpha terms (real-workload regime).
+    graph, _ = _mlp_grad_graph(batch=8192, din=1024, dh=1024, dout=1024)
+    planner = CostSpmdStrategy(graph, "data", 8)
+    gs = planner.run()
+    # x is invar 2 (params w1, w2, then x, y) — order from pytree flatten.
+    invars = graph.invars
+    x_var = invars[2]
+    w1_var = invars[0]
+    assert gs.var_strategies[x_var].is_split()
+    assert gs.var_strategies[x_var].partition_dim == 0
+    ws = gs.var_strategies[w1_var]
+    assert not ws.is_split()  # weights replicated under DP
+
+
+def test_ilp_status_and_cost_positive():
+    graph, _ = _mlp_grad_graph()
+    planner = CostSpmdStrategy(graph, "data", 4)
+    gs = planner.run()
+    assert gs.ilp_status in ("ilp", "greedy")
+    assert gs.total_cost > 0
+    # Every node got an assignment.
+    assert len(gs.node_out) == len(graph.nodes)
+
+
+def test_fixed_annotation_respected():
+    graph, _ = _mlp_grad_graph(batch=512)
+    x_var = graph.invars[2]
+    fixed = {x_var: DimStrategy.split_on(0, 8)}
+    gs = CostSpmdStrategy(graph, "data", 8, fixed=fixed).run()
+    assert gs.var_strategies[x_var].partition_dim == 0
+
+
+def test_tensor_parallel_emerges_for_huge_weights():
+    # Small batch, huge weight matrices (Megatron regime): the gradient
+    # all-reduce under DP would move 256 MB while activations are ~2 MB, so
+    # sharding at least one weight must beat both DP and full replication.
+    graph, _ = _mlp_grad_graph(batch=64, din=8192, dh=8192, dout=8192)
+    planner = CostSpmdStrategy(graph, "model", 4)
+    gs = planner.run()
+    split_weights = sum(
+        1 for v in (graph.invars[0], graph.invars[1])
+        if gs.var_strategies[v].is_split()
+    )
+    assert split_weights >= 1
+
+
+def test_transition_cost_shapes():
+    spec = chip_spec("v5e")
+    rep = DimStrategy.make_replicated(8)
+    s0 = DimStrategy.split_on(0, 8)
+    s1 = DimStrategy.split_on(1, 8)
+    par = DimStrategy.make_partial(8)
+    b = 1 << 20
+    assert transition_cost(s0, s0, b, 8, spec) == 0
+    assert transition_cost(rep, s0, b, 8, spec) == 0
+    assert transition_cost(s0, rep, b, 8, spec) > 0          # all-gather
+    assert transition_cost(s0, s1, b, 8, spec) > 0           # all-to-all
+    assert transition_cost(par, rep, b, 8, spec) > transition_cost(
+        par, s0, b, 8, spec) > 0                             # AR > RS
