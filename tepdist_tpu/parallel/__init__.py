@@ -1,0 +1,27 @@
+from tepdist_tpu.parallel.auto_parallel import (
+    ParallelPlan,
+    auto_parallel,
+    explore_topologies,
+    plan_axes,
+)
+from tepdist_tpu.parallel.cost_spmd_strategy import CostSpmdStrategy, GraphStrategy
+from tepdist_tpu.parallel.fast_spmd_strategy import FastSpmdStrategy
+from tepdist_tpu.parallel.performance_utils import PerfUtils, TpuChipSpec, chip_spec
+from tepdist_tpu.parallel.spmd_transform import ShardingPlan, SpmdTransform
+from tepdist_tpu.parallel.strategy_utils import StrategyUtil
+
+__all__ = [
+    "ParallelPlan",
+    "auto_parallel",
+    "explore_topologies",
+    "plan_axes",
+    "CostSpmdStrategy",
+    "GraphStrategy",
+    "FastSpmdStrategy",
+    "PerfUtils",
+    "TpuChipSpec",
+    "chip_spec",
+    "ShardingPlan",
+    "SpmdTransform",
+    "StrategyUtil",
+]

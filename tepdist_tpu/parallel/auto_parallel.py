@@ -1,0 +1,173 @@
+"""AutoParallel: the driver pass tying tracer → planner → SPMD transform.
+
+Reference parity: ``AutoParallel::Run`` (reference:
+service/parallel/auto_parallel.cc:395) with its three modes:
+  * rule mode  (``RULE_MODE``)  → FastSpmdStrategy annotation sweep
+  * config mode                 → fixed mesh from the caller, cost planner
+  * exploration mode            → enumerate mesh-shape proposals
+    (``GenerateSplitProposals``, auto_parallel.cc:132), plan each, keep the
+    evaluator-minimal one.
+
+Output is a ``ParallelPlan``: the sharded, jitted training step plus the
+full annotation record (the analogue of DistSpec-decorated HLO + DefContext
+tree, which later stages — pipeline decomposition, runtime — consume).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.extend import core as jexcore
+
+from tepdist_tpu.core.dist_spec import DimStrategy, TensorStrategy
+from tepdist_tpu.core.mesh import MeshTopology
+from tepdist_tpu.core.service_env import ServiceEnv
+from tepdist_tpu.graph.jaxpr_graph import JaxprGraph, trace_graph
+from tepdist_tpu.parallel.cost_spmd_strategy import CostSpmdStrategy, GraphStrategy
+from tepdist_tpu.parallel.fast_spmd_strategy import FastSpmdStrategy
+from tepdist_tpu.parallel.spmd_transform import ShardingPlan, SpmdTransform
+
+Var = jexcore.Var
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ParallelPlan:
+    """A planned + lowered training step."""
+
+    graph: JaxprGraph
+    topology: MeshTopology
+    strategies: List[GraphStrategy]
+    sharding_plan: ShardingPlan
+    in_tree: Any
+    out_tree: Any
+    mode: str
+
+    _flat_fn: Optional[Callable] = None
+    _mesh: Any = None
+
+    def mesh(self, devices=None):
+        if self._mesh is None:
+            self._mesh = self.topology.to_jax_mesh(devices)
+        return self._mesh
+
+    def executable(self, devices=None, donate_invars: Sequence[int] = ()):
+        """Flat-args jitted step (order = jaxpr invars)."""
+        if self._flat_fn is None:
+            xform = SpmdTransform(self.graph, self.topology)
+            self._flat_fn = xform.executable(
+                self.sharding_plan, self.mesh(devices),
+                donate_invars=donate_invars)
+        return self._flat_fn
+
+    def step(self, *args, **kwargs):
+        """Pytree-level convenience wrapper around the flat executable."""
+        flat, tree = jax.tree_util.tree_flatten((args, kwargs))
+        outs = self.executable()(*flat)
+        return jax.tree_util.tree_unflatten(self.out_tree, list(outs))
+
+    def input_shardings(self, devices=None):
+        from jax.sharding import NamedSharding
+        m = self.mesh(devices)
+        return [NamedSharding(m, s) for s in self.sharding_plan.in_specs]
+
+
+def _resolve_fixed(
+    graph: JaxprGraph,
+    annotations: Optional[Dict[int, Dict[str, DimStrategy]]],
+) -> Dict[str, Dict[Var, DimStrategy]]:
+    """annotations: flat-arg-index -> {axis: DimStrategy} → per-axis maps."""
+    per_axis: Dict[str, Dict[Var, DimStrategy]] = {}
+    for idx, spec in (annotations or {}).items():
+        v = graph.invars[idx]
+        for axis, s in spec.items():
+            per_axis.setdefault(axis, {})[v] = s
+    return per_axis
+
+
+def plan_axes(
+    graph: JaxprGraph,
+    topology: MeshTopology,
+    annotations: Optional[Dict[int, Dict[str, DimStrategy]]] = None,
+    mode: str = "cost",
+) -> List[GraphStrategy]:
+    """Run the per-axis planner sequence (reference: per-mesh-level
+    CostSpmdStrategy loop in RunExplorationlMode step 2)."""
+    fixed_per_axis = _resolve_fixed(graph, annotations)
+    strategies: List[GraphStrategy] = []
+    forbidden: Dict[Var, set] = {}
+    for name, size in topology.device_axes():
+        if size <= 1:
+            continue
+        fixed = fixed_per_axis.get(name, {})
+        if mode == "rule":
+            gs = FastSpmdStrategy(graph, name, size, fixed).run()
+        else:
+            gs = CostSpmdStrategy(
+                graph, name, size, fixed=fixed, forbidden_dims=forbidden
+            ).run()
+        strategies.append(gs)
+        # Later axes may not re-split dims this axis already split.
+        for v, s in gs.var_strategies.items():
+            if s.is_split():
+                forbidden.setdefault(v, set()).add(s.partition_dim)
+    return strategies
+
+
+def auto_parallel(
+    fn: Callable,
+    topology: MeshTopology,
+    *example_args,
+    annotations: Optional[Dict[int, Dict[str, DimStrategy]]] = None,
+    mode: Optional[str] = None,
+    **example_kwargs,
+) -> ParallelPlan:
+    """Plan ``fn`` over ``topology``. Modes: "cost" (default), "rule"."""
+    env = ServiceEnv.get()
+    if mode is None:
+        mode = "rule" if env.rule_mode else "cost"
+    if env.ignore_annotation:
+        annotations = None
+    graph, in_tree, out_tree = trace_graph(fn, *example_args, **example_kwargs)
+    strategies = plan_axes(graph, topology, annotations, mode)
+    xform = SpmdTransform(graph, topology)
+    sharding_plan = xform.lower(strategies)
+    return ParallelPlan(
+        graph=graph,
+        topology=topology,
+        strategies=strategies,
+        sharding_plan=sharding_plan,
+        in_tree=in_tree,
+        out_tree=out_tree,
+        mode=mode,
+    )
+
+
+def explore_topologies(
+    num_devices: int, max_levels: int = 2
+) -> List[MeshTopology]:
+    """Mesh-shape proposals for exploration mode (reference:
+    GenerateSplitProposals — factor device count into <=3 ordinals)."""
+    shapes: List[Tuple[Tuple[str, int], ...]] = []
+    # 1-level: pure data or pure model.
+    shapes.append((("data", num_devices),))
+    shapes.append((("model", num_devices),))
+    # 2-level factorizations data x model.
+    d = 2
+    while d * d <= num_devices:
+        if num_devices % d == 0:
+            shapes.append((("data", num_devices // d), ("model", d)))
+            shapes.append((("data", d), ("model", num_devices // d)))
+        d += 1
+    out = []
+    seen = set()
+    for axes in shapes:
+        key = tuple(axes)
+        if key not in seen:
+            seen.add(key)
+            out.append(MeshTopology(list(axes)))
+    return out

@@ -1,0 +1,105 @@
+"""Rule-based (annotation-driven) SPMD inference — the fast path.
+
+Reference parity: ``FastSpmdStrategyBase`` / ``AnnotFastSpmdStrategy``
+(reference: service/parallel/fast_spmd_strategy.{h,cc}, ~4.4k LoC): a single
+forward/backward sweep that spreads user ``xla_sharding``-style annotations
+through per-opcode transfer functions, without any cost search. Used when
+``RULE_MODE`` is on or as the planner for already-annotated graphs.
+
+Here the sweep runs over the jaxpr graph using the shared ``StrategyUtil``
+transfer functions; the result is the same ``GraphStrategy`` the cost planner
+produces, so the SPMD transform is agnostic to which planner ran.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+from jax.extend import core as jexcore
+
+from tepdist_tpu.core.dist_spec import DimStrategy
+from tepdist_tpu.graph.jaxpr_graph import JaxprGraph
+from tepdist_tpu.parallel.cost_spmd_strategy import GraphStrategy
+from tepdist_tpu.parallel.strategy_utils import StrategyUtil
+
+Var = jexcore.Var
+
+
+class FastSpmdStrategy:
+    """Fixpoint annotation propagation for one mesh axis."""
+
+    def __init__(self, graph: JaxprGraph, axis_name: str, num_splits: int,
+                 fixed: Dict[Var, DimStrategy]):
+        self.graph = graph
+        self.axis = axis_name
+        self.n = num_splits
+        self.fixed = dict(fixed)
+
+    def run(self) -> GraphStrategy:
+        value: Dict[Var, DimStrategy] = dict(self.fixed)
+        worklist = deque()
+        for v in value:
+            worklist.extend(self.graph.arg_consumers(v))
+            prod = self.graph.producer.get(v)
+            if prod:
+                worklist.append(prod[0])
+        visited_count: Dict[int, int] = {}
+        while worklist:
+            node = worklist.popleft()
+            if visited_count.get(node.id, 0) > 4:
+                continue  # fixpoint guard
+            visited_count[node.id] = visited_count.get(node.id, 0) + 1
+            known = {}
+            for i, a in enumerate(node.invars):
+                if isinstance(a, Var) and a in value and (
+                        value[a].is_split() or value[a].partial):
+                    known[i] = value[a]
+            r = StrategyUtil.forward_infer(node.eqn, known, self.n)
+            if r is None and len(known) > 1:
+                first = dict([next(iter(known.items()))])
+                r = StrategyUtil.forward_infer(node.eqn, first, self.n)
+            if r is None:
+                continue
+            changed = False
+            for ov, s in zip(node.outvars, r.out_strategies):
+                if isinstance(ov, Var) and ov not in value and (
+                        s.is_split() or s.partial):
+                    value[ov] = s
+                    changed = True
+            # Backward: demand operand strategies implied by this op.
+            for a, s in zip(node.invars, r.in_strategies):
+                if (isinstance(a, Var) and s is not None and s.is_split()
+                        and a not in value):
+                    value[a] = s
+                    changed = True
+                    prod = self.graph.producer.get(a)
+                    if prod:
+                        worklist.append(prod[0])
+                    worklist.extend(self.graph.arg_consumers(a))
+            if changed:
+                for ov in node.outvars:
+                    if isinstance(ov, Var):
+                        worklist.extend(self.graph.arg_consumers(ov))
+        rep = DimStrategy.make_replicated(self.n)
+        var_strat = {}
+        for v in list(self.graph.invars) + list(self.graph.constvars):
+            var_strat[v] = value.get(v, rep)
+        node_out: Dict[int, List[DimStrategy]] = {}
+        for node in self.graph.nodes:
+            node_out[node.id] = [
+                value.get(ov, rep) if isinstance(ov, Var) else rep
+                for ov in node.outvars
+            ]
+        outs: List[Optional[DimStrategy]] = []
+        for a in self.graph.outvars:
+            outs.append(value.get(a, rep) if isinstance(a, Var) else None)
+        return GraphStrategy(
+            axis_name=self.axis,
+            num_splits=self.n,
+            var_strategies=var_strat,
+            node_out=node_out,
+            out_strategies=outs,
+            total_cost=0.0,
+            ilp_status="rule",
+        )

@@ -1,0 +1,178 @@
+"""SPMD transform: lower planned strategies onto XLA GSPMD.
+
+Reference parity: ``SpmdTransform`` (reference:
+service/parallel/spmd_transform.{h,cc}, ~3.1k LoC) rewrote every HLO
+instruction's shape by hand and inserted kCustomCollective nodes, which
+``CustomCollectiveExpander`` later lowered to kDAPPLE collectives. On TPU both
+jobs belong to the XLA SPMD partitioner: we emit
+  * ``NamedSharding`` for every input and output, and
+  * ``with_sharding_constraint`` at planner-decided interior anchor points
+    (cone roots),
+then let GSPMD perform the per-op rewrite and insert the ICI collectives
+(all-reduce/all-gather/all-to-all/collective-permute). This replaces ~4k LoC
+of per-opcode rewriting with the compiler path TPUs are designed for.
+
+The transform works by re-interpreting the planner's inlined jaxpr with
+constraints woven in — so the executed program is exactly the analyzed one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+from jax.extend import core as jexcore
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tepdist_tpu.core.dist_spec import DimStrategy, TensorStrategy
+from tepdist_tpu.core.mesh import MeshTopology
+from tepdist_tpu.graph.jaxpr_graph import JaxprGraph
+from tepdist_tpu.parallel.cost_spmd_strategy import GraphStrategy
+
+Var = jexcore.Var
+Literal = jexcore.Literal
+
+
+def combine_axis_strategies(
+    graph: JaxprGraph, strategies: Sequence[GraphStrategy]
+) -> Dict[Var, TensorStrategy]:
+    """Merge per-axis planning results into one TensorStrategy per var
+    (vars covered: graph inputs + every node output)."""
+    combined: Dict[Var, TensorStrategy] = {}
+
+    def add(v: Var, axis: str, s: DimStrategy):
+        combined.setdefault(v, TensorStrategy()).set(axis, s)
+
+    for gs in strategies:
+        for v, s in gs.var_strategies.items():
+            add(v, gs.axis_name, s)
+        for nid, outs in gs.node_out.items():
+            node = graph.nodes[nid]
+            for ov, s in zip(node.outvars, outs):
+                if isinstance(ov, Var):
+                    add(ov, gs.axis_name, s)
+    return combined
+
+
+@dataclasses.dataclass
+class ShardingPlan:
+    """Lowered plan: PartitionSpecs for I/O + interior constraint points."""
+
+    topology: MeshTopology
+    in_specs: List[PartitionSpec]              # one per jaxpr invar
+    out_specs: List[Optional[PartitionSpec]]   # one per jaxpr outvar
+    constraints: Dict[Var, PartitionSpec]      # interior anchors
+    var_strategies: Dict[Var, TensorStrategy]
+
+    def mesh(self, devices=None) -> Mesh:
+        return self.topology.to_jax_mesh(devices)
+
+
+class SpmdTransform:
+    """Build a ShardingPlan and an executable sharded step function."""
+
+    def __init__(self, graph: JaxprGraph, topology: MeshTopology):
+        self.graph = graph
+        self.topology = topology
+
+    def lower(self, strategies: Sequence[GraphStrategy]) -> ShardingPlan:
+        combined = combine_axis_strategies(self.graph, strategies)
+        in_specs = []
+        for v in self.graph.invars:
+            ts = combined.get(v, TensorStrategy())
+            in_specs.append(ts.partition_spec(len(v.aval.shape)))
+        out_specs: List[Optional[PartitionSpec]] = []
+        for a in self.graph.outvars:
+            if isinstance(a, Var) and a in combined:
+                ts = combined[a]
+                if ts.has_partial():
+                    # psum inserted by GSPMD; the materialized output is
+                    # replicated along the partial axes.
+                    ts = TensorStrategy({
+                        ax: s for ax, s in ts.strategies.items() if not s.partial
+                    })
+                out_specs.append(ts.partition_spec(len(a.aval.shape)))
+            else:
+                out_specs.append(None)
+        constraints: Dict[Var, PartitionSpec] = {}
+        for node in self.graph.nodes:
+            if not node.is_compute_intensive():
+                continue
+            for ov in node.outvars:
+                if not isinstance(ov, Var) or ov not in combined:
+                    continue
+                ts = combined[ov]
+                if ts.has_partial():
+                    continue  # partial values are GSPMD's to resolve
+                spec = ts.partition_spec(len(ov.aval.shape))
+                if spec != PartitionSpec():
+                    constraints[ov] = spec
+        return ShardingPlan(
+            topology=self.topology,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            constraints=constraints,
+            var_strategies=combined,
+        )
+
+    # ------------------------------------------------------------------
+    def executable(
+        self,
+        plan: ShardingPlan,
+        mesh: Optional[Mesh] = None,
+        donate_invars: Sequence[int] = (),
+        constrain_interior: bool = True,
+    ) -> Callable:
+        """JIT the planned program with GSPMD shardings.
+
+        Returns a function over FLAT invars (same order as
+        ``graph.invars``) returning flat outputs — runtime layers wrap
+        pytrees around it."""
+        mesh = mesh or plan.mesh()
+        jaxpr = self.graph.jaxpr
+        consts = list(self.graph.closed.consts)
+        constraints = {
+            v: NamedSharding(mesh, spec)
+            for v, spec in (plan.constraints.items() if constrain_interior else ())
+        }
+
+        def run(*flat_args):
+            env: Dict[Var, Any] = {}
+
+            def read(a):
+                if isinstance(a, Literal):
+                    return a.val
+                return env[a]
+
+            def write(v, val):
+                sh = constraints.get(v)
+                if sh is not None:
+                    val = jax.lax.with_sharding_constraint(val, sh)
+                env[v] = val
+
+            for cv, c in zip(jaxpr.constvars, consts):
+                write(cv, c)
+            for iv, a in zip(jaxpr.invars, flat_args):
+                write(iv, a)
+            for eqn in jaxpr.eqns:
+                vals = [read(a) for a in eqn.invars]
+                outs = eqn.primitive.bind(*vals, **eqn.params)
+                if not eqn.primitive.multiple_results:
+                    outs = [outs]
+                for ov, val in zip(eqn.outvars, outs):
+                    if type(ov).__name__ != "DropVar":
+                        write(ov, val)
+            return tuple(read(a) for a in jaxpr.outvars)
+
+        in_shardings = tuple(NamedSharding(mesh, s) for s in plan.in_specs)
+        out_shardings = tuple(
+            NamedSharding(mesh, s) if s is not None else None
+            for s in plan.out_specs
+        )
+        return jax.jit(
+            run,
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+            donate_argnums=tuple(donate_invars),
+        )

@@ -1,0 +1,113 @@
+"""End-to-end AutoParallel tests on the virtual 8-device CPU mesh: the
+sharded program must match the unsharded numerics exactly (the reference's
+smoke-test criterion — same loss trajectory — made strict)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tepdist_tpu.core.dist_spec import DimStrategy
+from tepdist_tpu.core.mesh import MeshTopology
+from tepdist_tpu.parallel.auto_parallel import auto_parallel, explore_topologies
+
+
+def _mlp():
+    def loss_and_grad(params, x, y):
+        def loss(p, x, y):
+            h = jax.nn.relu(x @ p["w1"])
+            logits = h @ p["w2"]
+            return jnp.mean((logits - y) ** 2)
+
+        l, g = jax.value_and_grad(loss)(params, x, y)
+        return l, g
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    params = {
+        "w1": jax.random.normal(k1, (64, 128)) * 0.1,
+        "w2": jax.random.normal(k2, (128, 32)) * 0.1,
+    }
+    x = jax.random.normal(k3, (256, 64))
+    y = jnp.ones((256, 32))
+    return loss_and_grad, params, x, y
+
+
+def test_dp_plan_matches_unsharded(devices):
+    fn, params, x, y = _mlp()
+    topo = MeshTopology([("data", 8)])
+    plan = auto_parallel(fn, topo, params, x, y)
+    expected_l, expected_g = fn(params, x, y)
+    got_l, got_g = plan.step(params, x, y)
+    np.testing.assert_allclose(np.asarray(got_l), np.asarray(expected_l),
+                               rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-4, atol=1e-6),
+        got_g, expected_g)
+
+
+def test_2d_mesh_plan_matches(devices):
+    fn, params, x, y = _mlp()
+    topo = MeshTopology([("data", 2), ("model", 4)])
+    plan = auto_parallel(fn, topo, params, x, y)
+    expected_l, _ = fn(params, x, y)
+    got_l, _ = plan.step(params, x, y)
+    np.testing.assert_allclose(np.asarray(got_l), np.asarray(expected_l),
+                               rtol=1e-5)
+
+
+def test_rule_mode_with_annotation(devices):
+    fn, params, x, y = _mlp()
+    topo = MeshTopology([("data", 8)])
+    # Annotate the batch input (flat arg order: w1, w2, x, y).
+    plan = auto_parallel(
+        fn, topo, params, x, y,
+        annotations={2: {"data": DimStrategy.split_on(0, 8)},
+                     3: {"data": DimStrategy.split_on(0, 8)}},
+        mode="rule",
+    )
+    assert plan.strategies[0].ilp_status == "rule"
+    x_spec = plan.sharding_plan.in_specs[2]
+    assert x_spec == jax.sharding.PartitionSpec("data")
+    expected_l, _ = fn(params, x, y)
+    got_l, _ = plan.step(params, x, y)
+    np.testing.assert_allclose(np.asarray(got_l), np.asarray(expected_l),
+                               rtol=1e-5)
+
+
+def test_plan_shards_batch_input():
+    # Trace-only (ShapeDtypeStruct) at DP-favoring scale: batch must shard.
+    fn, *_ = _mlp()
+    f32 = jnp.float32
+    params = {
+        "w1": jax.ShapeDtypeStruct((1024, 1024), f32),
+        "w2": jax.ShapeDtypeStruct((1024, 1024), f32),
+    }
+    x = jax.ShapeDtypeStruct((8192, 1024), f32)
+    y = jax.ShapeDtypeStruct((8192, 1024), f32)
+    topo = MeshTopology([("data", 8)])
+    plan = auto_parallel(fn, topo, params, x, y)
+    in_specs = plan.sharding_plan.in_specs
+    assert in_specs[2] == jax.sharding.PartitionSpec("data")
+    # Outputs: loss replicated, grads well-defined specs.
+    assert len(plan.sharding_plan.out_specs) == 3  # loss, gw1, gw2
+
+
+def test_actual_device_placement(devices):
+    fn, params, x, y = _mlp()
+    topo = MeshTopology([("data", 8)])
+    plan = auto_parallel(fn, topo, params, x, y)
+    flat, _ = jax.tree_util.tree_flatten(((params, x, y), {}))
+    outs = plan.executable()(*flat)
+    # Batch-split input: check x's sharding actually spans 8 devices.
+    shardings = plan.input_shardings()
+    x_sh = shardings[2]
+    assert len(x_sh.device_set) == 8
+
+
+def test_explore_topologies_enumeration():
+    topos = explore_topologies(8)
+    names = [str(t) for t in topos]
+    assert any("data=8" in n for n in names)
+    assert any("model=8" in n for n in names)
+    assert any("data=4" in n and "model=2" in n for n in names)
