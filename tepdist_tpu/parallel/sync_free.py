@@ -1,0 +1,259 @@
+"""Sync-free (gradient accumulation / micro-batching) analysis + transform.
+
+Reference parity: ``SyncFreeSplittingAnalysis`` finds a batch-dim split whose
+largest subgraph (fwd+bwd up to the gradient sync points) runs per-micro-batch
+without cross-replica synchronization and decides ``num_micro_batches``;
+``SyncFreeDecomposition`` then physically splits ENTRY into CG (per-micro
+compute), GAInit (zero buffers), GA (accumulate), and AG (apply gradients)
+computations wired through DefContexts (reference:
+service/parallel/sync_free_splitting_analysis.{h,cc},
+sync_free_decomposition.{h,cc}, sync_free_chain.h).
+
+TPU-native mechanism: the decomposition is *constructed*, not carved out of a
+traced module — ``build_ga_step`` emits one jit-able function where
+  GAInit = tree-zeros carry init, CG = per-micro value_and_grad inside
+  ``lax.scan``, GA = carry add, AG = the optimizer apply after the scan.
+XLA sees the whole thing and overlaps micro-batches with the GA adds; the
+micro ordinal is a *time* axis (share_dev_flags=true in the reference's
+terms), so no devices are consumed.
+
+The *analysis* half stays: it detects the sync-free batch dim on the traced
+graph and sizes the micro-batch count from the activation-memory estimate
+(reference decided it from sync-point structure + memory, too).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.extend import core as jexcore
+
+from tepdist_tpu.core.service_env import ServiceEnv
+from tepdist_tpu.graph.cost import aval_bytes
+from tepdist_tpu.graph.jaxpr_graph import JaxprGraph
+from tepdist_tpu.parallel.performance_utils import chip_spec
+from tepdist_tpu.parallel.strategy_utils import StrategyUtil
+from tepdist_tpu.core.dist_spec import DimStrategy
+
+Var = jexcore.Var
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class SyncFreeResult:
+    """Decision record of the analysis."""
+
+    batch_arg_indices: List[int]     # flat invar indices carrying the batch dim
+    batch_dims: Dict[int, int]       # arg index -> batch dim
+    sync_free_fraction: float        # fraction of flops in the sync-free set
+    num_micro_batches: int
+    peak_activation_bytes: float
+
+
+def find_sync_free_split(
+    graph: JaxprGraph, candidate_args: Optional[List[int]] = None
+) -> Optional[Tuple[Dict[int, int], float]]:
+    """Find batch dims on data args such that forward propagation reaches a
+    maximal flop fraction with partials only at gradient-shaped sinks
+    (reference: SearchForMostSyncFreeInsts).
+
+    Tries dim 0 of each non-matrix arg set; returns ({arg: dim}, fraction)."""
+    n_probe = 2  # split factor used only for feasibility probing
+    best: Optional[Tuple[Dict[int, int], float]] = None
+    indices = candidate_args
+    if indices is None:
+        indices = list(range(len(graph.invars)))
+    # Group candidate args by their dim-0 size: batch args share it.
+    by_size: Dict[int, List[int]] = {}
+    for i in indices:
+        shape = graph.invars[i].aval.shape
+        if len(shape) >= 1 and shape[0] % n_probe == 0:
+            by_size.setdefault(shape[0], []).append(i)
+    for size, args in by_size.items():
+        # Args whose dim 0 merely coincides with the batch size (e.g. a
+        # [batch_like, d] weight) poison the split: drop any arg whose
+        # inclusion lowers the sync-free fraction.
+        assign = {i: 0 for i in args}
+        frac = _probe_fraction(graph, assign, n_probe)
+        for i in list(assign):
+            if len(assign) == 1:
+                break
+            trial = {k: v for k, v in assign.items() if k != i}
+            trial_frac = _probe_fraction(graph, trial, n_probe)
+            if trial_frac > frac:
+                assign, frac = trial, trial_frac
+        if frac > 0 and (best is None or frac > best[1]):
+            best = (assign, frac)
+    return best
+
+
+def _probe_fraction(graph: JaxprGraph, assign: Dict[int, int], n: int) -> float:
+    """Forward-propagate the candidate split; return flop fraction of nodes
+    that stay split or partial (i.e. run per-micro-batch sync-free)."""
+    value: Dict[Var, DimStrategy] = {}
+    for i, d in assign.items():
+        v = graph.invars[i]
+        value[v] = DimStrategy.split_on(d, n)
+    covered = 0.0
+    total = graph.total_flops() or 1.0
+    for node in graph.nodes:
+        known = {}
+        for k, a in enumerate(node.invars):
+            if isinstance(a, Var) and a in value and (
+                    value[a].is_split() or value[a].partial):
+                known[k] = value[a]
+        if not known:
+            continue
+        r = StrategyUtil.forward_infer(node.eqn, known, n)
+        if r is None and len(known) > 1:
+            r = StrategyUtil.forward_infer(
+                node.eqn, dict([next(iter(known.items()))]), n)
+        if r is None:
+            continue
+        moved = False
+        for ov, s in zip(node.outvars, r.out_strategies):
+            if isinstance(ov, Var) and (s.is_split() or s.partial):
+                value[ov] = s
+                moved = True
+        if moved:
+            covered += node.flops
+    return covered / total
+
+
+def estimate_peak_activation_bytes(graph: JaxprGraph) -> float:
+    """Liveness-based peak estimate: sweep program order, tracking bytes of
+    values whose last use is later (reference: memory feasibility input to
+    the analysis / Evaluator)."""
+    last_use: Dict[Var, int] = {}
+    for node in graph.nodes:
+        for a in node.invars:
+            if isinstance(a, Var):
+                last_use[a] = node.id
+    for a in graph.outvars:
+        if isinstance(a, Var):
+            last_use[a] = len(graph.nodes) + 1
+    live = 0.0
+    peak = 0.0
+    expiry: Dict[int, float] = {}
+    for node in graph.nodes:
+        for ov in node.outvars:
+            if isinstance(ov, Var) and ov in last_use:
+                b = aval_bytes(ov.aval)
+                live += b
+                expiry[last_use[ov]] = expiry.get(last_use[ov], 0.0) + b
+        peak = max(peak, live)
+        live -= expiry.pop(node.id, 0.0)
+    return peak
+
+
+def choose_num_micro_batches(
+    graph: JaxprGraph,
+    batch_size: int,
+    hbm_budget_bytes: Optional[float] = None,
+    usage_ratio: float = 0.6,
+) -> int:
+    env = ServiceEnv.get()
+    if env.num_micro_batches > 0:
+        return env.num_micro_batches
+    if hbm_budget_bytes is None:
+        hbm_budget_bytes = chip_spec().hbm_gb * 1e9
+    peak = estimate_peak_activation_bytes(graph)
+    budget = hbm_budget_bytes * usage_ratio
+    n = 1
+    while peak / n > budget and n < batch_size:
+        n *= 2
+    while batch_size % n != 0 and n > 1:
+        n //= 2
+    return max(1, n)
+
+
+def analyze_sync_free(
+    graph: JaxprGraph,
+    batch_size: int,
+    candidate_args: Optional[List[int]] = None,
+    hbm_budget_bytes: Optional[float] = None,
+) -> SyncFreeResult:
+    found = find_sync_free_split(graph, candidate_args)
+    if found is None:
+        return SyncFreeResult([], {}, 0.0, 1, estimate_peak_activation_bytes(graph))
+    assign, frac = found
+    n = choose_num_micro_batches(graph, batch_size, hbm_budget_bytes)
+    return SyncFreeResult(
+        batch_arg_indices=sorted(assign),
+        batch_dims=assign,
+        sync_free_fraction=frac,
+        num_micro_batches=n,
+        peak_activation_bytes=estimate_peak_activation_bytes(graph),
+    )
+
+
+# --------------------------------------------------------------------------
+# The decomposition (constructive form)
+# --------------------------------------------------------------------------
+
+def build_ga_step(
+    grad_fn: Callable,
+    apply_fn: Callable,
+    num_micro_batches: int,
+    batch_argnums: Tuple[int, ...] = (1,),
+    batch_dim: int = 0,
+) -> Callable:
+    """Construct the sync-free GA training step (reference decomposition
+    ENTRY -> {GAINIT, CG, GA, AG} as one scanned program).
+
+    Args:
+      grad_fn: ``(params, *batch) -> (loss, grads)`` per-micro-batch.
+      apply_fn: ``(params, opt_state, grads) -> (new_params, new_opt_state)``.
+      num_micro_batches: micro ordinal size (a time axis: no devices).
+      batch_argnums: positions (in the step signature after params/opt_state)
+        of batch-carrying args to split along ``batch_dim``.
+
+    Returns ``step(params, opt_state, *batch) -> (mean_loss, params, opt)``.
+    """
+    if num_micro_batches <= 1:
+        def step1(params, opt_state, *batch):
+            loss, grads = grad_fn(params, *batch)
+            params, opt_state = apply_fn(params, opt_state, grads)
+            return loss, params, opt_state
+        return step1
+
+    def step(params, opt_state, *batch):
+        def resplit(i, b):
+            if i + 1 not in batch_argnums:  # argnums count params as 0
+                return b
+            shape = b.shape
+            m = shape[batch_dim] // num_micro_batches
+            new_shape = (
+                shape[:batch_dim]
+                + (num_micro_batches, m)
+                + shape[batch_dim + 1:]
+            )
+            b = b.reshape(new_shape)
+            # scan consumes leading axis
+            return jnp.moveaxis(b, batch_dim, 0)
+
+        micro_batches = tuple(resplit(i, b) for i, b in enumerate(batch))
+
+        # GAInit: zero accumulators shaped like the gradients.
+        acc0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+        def body(carry, mb):  # CG + GA
+            acc, loss_sum = carry
+            loss, grads = grad_fn(params, *mb)
+            acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+            return (acc, loss_sum + loss), None
+
+        (acc, loss_sum), _ = lax.scan(
+            body, (acc0, jnp.zeros(())), micro_batches)
+        inv = 1.0 / num_micro_batches
+        grads = jax.tree_util.tree_map(lambda g: g * inv, acc)
+        # AG: apply-gradients slice.
+        params, opt_state = apply_fn(params, opt_state, grads)
+        return loss_sum * inv, params, opt_state
+
+    return step

@@ -1,0 +1,127 @@
+"""Sync-free analysis + GA decomposition tests (reference:
+sync_free_splitting_analysis / sync_free_decomposition behavior)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tepdist_tpu.graph.jaxpr_graph import trace_graph
+from tepdist_tpu.parallel.sync_free import (
+    analyze_sync_free,
+    build_ga_step,
+    choose_num_micro_batches,
+    estimate_peak_activation_bytes,
+    find_sync_free_split,
+)
+
+
+def _setup(batch=48, din=32, dh=64, dout=8):
+    def loss_fn(params, x, y):
+        h = jax.nn.relu(x @ params["w1"])
+        logits = h @ params["w2"]
+        return jnp.mean((logits - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    k1, k2, k3, k4 = jax.random.split(k, 4)
+    params = {
+        "w1": jax.random.normal(k1, (din, dh)) * 0.1,
+        "w2": jax.random.normal(k2, (dh, dout)) * 0.1,
+    }
+    x = jax.random.normal(k3, (batch, din))
+    y = jax.random.normal(k4, (batch, dout))
+    return loss_fn, params, x, y
+
+
+def test_find_sync_free_split_identifies_batch():
+    loss_fn, params, x, y = _setup()
+    graph, _, _ = trace_graph(jax.grad(loss_fn), params, x, y)
+    found = find_sync_free_split(graph)
+    assert found is not None
+    assign, frac = found
+    # x and y are flat args 2 and 3; both carry the batch dim 0.
+    assert set(assign) == {2, 3}
+    assert all(d == 0 for d in assign.values())
+    assert frac > 0.5  # most flops are per-micro-batch
+
+
+def test_peak_activation_estimate_positive():
+    loss_fn, params, x, y = _setup()
+    graph, _, _ = trace_graph(jax.grad(loss_fn), params, x, y)
+    peak = estimate_peak_activation_bytes(graph)
+    assert peak > 0
+    # Peak must be less than total bytes of all intermediates.
+    total = sum(n.out_bytes() for n in graph.nodes)
+    assert peak <= total
+
+
+def test_choose_num_micro_batches_memory_driven():
+    loss_fn, params, x, y = _setup(batch=64, din=32, dh=96, dout=8)
+    graph, _, _ = trace_graph(jax.grad(loss_fn), params, x, y)
+    # Huge budget: 1 micro batch.
+    assert choose_num_micro_batches(graph, 64, hbm_budget_bytes=1e12) == 1
+    # Tiny budget: forces splitting, must divide batch.
+    n = choose_num_micro_batches(graph, 64, hbm_budget_bytes=20_000)
+    assert n > 1 and 64 % n == 0
+
+
+def test_analyze_sync_free_end_to_end():
+    loss_fn, params, x, y = _setup()
+    graph, _, _ = trace_graph(jax.grad(loss_fn), params, x, y)
+    res = analyze_sync_free(graph, batch_size=64, hbm_budget_bytes=1e12)
+    assert res.num_micro_batches == 1
+    assert res.sync_free_fraction > 0.5
+    assert res.batch_dims
+
+
+def test_ga_step_matches_full_batch():
+    loss_fn, params, x, y = _setup(batch=64, din=32, dh=96, dout=8)
+    tx = optax.sgd(0.1)
+    opt_state = tx.init(params)
+
+    def grad_fn(p, x, y):
+        return jax.value_and_grad(loss_fn)(p, x, y)
+
+    def apply_fn(p, s, g):
+        updates, s = tx.update(g, s, p)
+        return optax.apply_updates(p, updates), s
+
+    full_step = build_ga_step(grad_fn, apply_fn, 1)
+    ga_step = build_ga_step(grad_fn, apply_fn, 8, batch_argnums=(1, 2))
+
+    l1, p1, _ = jax.jit(full_step)(params, opt_state, x, y)
+    l2, p2, _ = jax.jit(ga_step)(params, opt_state, x, y)
+    # Mean loss over micro batches == full-batch mean loss (mean MSE).
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        p1, p2)
+
+
+def test_ga_step_composes_with_auto_parallel(devices):
+    from tepdist_tpu.core.mesh import MeshTopology
+    from tepdist_tpu.parallel.auto_parallel import auto_parallel
+
+    loss_fn, params, x, y = _setup(batch=64, din=32, dh=96, dout=8)
+    tx = optax.sgd(0.1)
+    opt_state = tx.init(params)
+
+    def grad_fn(p, x, y):
+        return jax.value_and_grad(loss_fn)(p, x, y)
+
+    def apply_fn(p, s, g):
+        updates, s = tx.update(g, s, p)
+        return optax.apply_updates(p, updates), s
+
+    ga_step = build_ga_step(grad_fn, apply_fn, 4, batch_argnums=(1, 2))
+    topo = MeshTopology(
+        [("micro", 4), ("data", 8)], share_dev_flags=[True, False])
+    plan = auto_parallel(ga_step, topo, params, opt_state, x, y)
+    l_ref, p_ref, _ = ga_step(params, opt_state, x, y)
+    l, p, _ = plan.step(params, opt_state, x, y)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l_ref), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        p, p_ref)
