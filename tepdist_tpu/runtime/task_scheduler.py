@@ -1,0 +1,183 @@
+"""TaskScheduler: discrete-event simulation → per-device static task lists.
+
+Reference parity: ``TaskScheduler::Schedule`` (reference:
+pjrt/task_scheduler.{h,cc}: ClusterState→MachineState→DevState hierarchy,
+per-device ready queues, per-task time estimates, memory accounting with OOM
+state, ``MICRO_NUM_LIMIT`` in-flight micro-batch cap, ``GROUP_SCHED_COUNT``
+candidate schedules, Reorder post-passes). The simulated order is the static
+execution order — deadlock-freedom is proven before anything runs.
+
+The in-flight cap is what turns the greedy list schedule into 1F1B: once
+``MICRO_NUM_LIMIT`` forwards are outstanding on a stage, its backward tasks
+outrank further forwards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tepdist_tpu.core.service_env import ServiceEnv
+from tepdist_tpu.parallel.performance_utils import PerfUtils, chip_spec
+from tepdist_tpu.runtime.task_graph import TaskDAG, TaskNode, TaskType
+
+
+@dataclasses.dataclass
+class ScheduleResult:
+    order: List[int]                          # global start order (task ids)
+    per_device: Dict[Tuple[int, ...], List[int]]  # device-group -> task ids
+    start: Dict[int, float]
+    finish: Dict[int, float]
+    makespan: float
+    peak_bytes: Dict[int, float]              # per global device id
+    bubble_ratio: float
+
+    def device_list(self, dev: int) -> List[int]:
+        out = []
+        for group, tasks in self.per_device.items():
+            if dev in group:
+                out.extend(tasks)
+        return sorted(out, key=lambda t: self.start[t])
+
+
+class TaskScheduler:
+    """List scheduler over a TaskDAG with simulated time + memory."""
+
+    def __init__(self, dag: TaskDAG, chip=None,
+                 micro_num_limit: Optional[int] = None,
+                 mem_limit_bytes: Optional[float] = None):
+        env = ServiceEnv.get()
+        self.dag = dag
+        self.spec = chip or chip_spec()
+        self.micro_limit = (micro_num_limit if micro_num_limit is not None
+                            else env.micro_num_limit)
+        self.mem_limit = mem_limit_bytes
+
+    # -- time model -------------------------------------------------------
+    def task_time(self, n: TaskNode) -> float:
+        if n.task_type == TaskType.COMPUTE:
+            ndev = max(len(n.device_group), 1)
+            return max(PerfUtils.compute_time(n.flops / ndev, self.spec), 1e-7)
+        if n.task_type in (TaskType.SEND, TaskType.RECV):
+            return max(PerfUtils.ppermute_cost(n.out_bytes, self.spec), 1e-7)
+        if n.task_type == TaskType.AR:
+            ndev = max(len(n.device_group), 1)
+            return max(PerfUtils.all_reduce_cost(n.out_bytes, ndev, self.spec),
+                       1e-7)
+        if n.task_type in (TaskType.GA, TaskType.GAINIT, TaskType.APPLY):
+            return max(PerfUtils.hbm_time(n.out_bytes, self.spec), 1e-7)
+        return 1e-8
+
+    # -- scheduling -------------------------------------------------------
+    def schedule(self) -> ScheduleResult:
+        """Try GROUP_SCHED_COUNT window policies, keep the best makespan
+        (reference: candidate schedules loop)."""
+        env = ServiceEnv.get()
+        candidates = []
+        windows = [self.micro_limit]
+        for delta in range(1, env.group_sched_count):
+            w = self.micro_limit + delta
+            windows.append(w)
+        best = None
+        for w in windows[: env.group_sched_count]:
+            r = self._simulate(w)
+            if best is None or r.makespan < best.makespan:
+                best = r
+        return best
+
+    def _simulate(self, window: int) -> ScheduleResult:
+        dag = self.dag
+        indeg = {n.id: len(n.parents) for n in dag.nodes}
+        dev_free: Dict[int, float] = {}
+        task_finish: Dict[int, float] = {}
+        start: Dict[int, float] = {}
+        order: List[int] = []
+        per_device: Dict[Tuple[int, ...], List[int]] = {}
+        # in-flight micro-batches per stage (fwd started, bwd not finished)
+        inflight: Dict[int, set] = {}
+        ready: List[Tuple[Tuple, int]] = []
+
+        def is_bwd(n: TaskNode) -> bool:
+            return n.task_type == TaskType.COMPUTE and "bwd" in n.name
+
+        def is_fwd(n: TaskNode) -> bool:
+            return n.task_type == TaskType.COMPUTE and "fwd" in n.name
+
+        def priority(n: TaskNode) -> Tuple:
+            # 1F1B: backward tasks outrank forwards when the stage window is
+            # full; otherwise lower micro index first, deeper stage first for
+            # bwd (drain), shallower first for fwd (fill).
+            stage_full = (is_fwd(n) and window > 0 and
+                          len(inflight.get(n.stage, ())) >= window)
+            cls = 1 if stage_full else 0
+            bwd_bonus = 0 if is_bwd(n) else 1
+            return (cls, n.micro if n.micro >= 0 else 0, bwd_bonus, n.id)
+
+        for n in dag.nodes:
+            if indeg[n.id] == 0:
+                heapq.heappush(ready, (priority(n), n.id))
+
+        sim_busy: Dict[int, float] = {}
+        while ready:
+            # Re-sort lazily: pop best currently-valid entry.
+            _, tid = heapq.heappop(ready)
+            n = dag.node(tid)
+            pr = priority(n)
+            if ready and pr > ready[0][0]:
+                heapq.heappush(ready, (pr, tid))
+                _, tid = heapq.heappop(ready)
+                n = dag.node(tid)
+            t_ready = max((task_finish[p] for p in n.parents), default=0.0)
+            t_dev = max((dev_free.get(d, 0.0) for d in n.device_group),
+                        default=0.0)
+            t0 = max(t_ready, t_dev)
+            dur = self.task_time(n)
+            start[n.id] = t0
+            task_finish[n.id] = t0 + dur
+            order.append(n.id)
+            per_device.setdefault(tuple(n.device_group), []).append(n.id)
+            for d in n.device_group:
+                dev_free[d] = t0 + dur
+                sim_busy[d] = sim_busy.get(d, 0.0) + (
+                    dur if n.task_type == TaskType.COMPUTE else 0.0)
+            if is_fwd(n):
+                inflight.setdefault(n.stage, set()).add(n.micro)
+            if is_bwd(n):
+                inflight.setdefault(n.stage, set()).discard(n.micro)
+            for c in n.children:
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    cn = dag.node(c)
+                    heapq.heappush(ready, (priority(cn), c))
+        if len(order) != len(dag.nodes):
+            raise RuntimeError("schedule deadlock: DAG not fully drained")
+
+        makespan = max(task_finish.values(), default=0.0)
+        peak = self._memory_account(order)
+        busy = sum(sim_busy.values())
+        ndev = max(len(dev_free), 1)
+        bubble = 1.0 - busy / (ndev * makespan) if makespan > 0 else 0.0
+        return ScheduleResult(order, per_device, start, task_finish,
+                              makespan, peak, bubble)
+
+    def _memory_account(self, order: List[int]) -> Dict[int, float]:
+        """Replay the schedule tracking live output bytes per device
+        (reference: DevState memory accounting with OOM state)."""
+        self.dag.build_gc_plan(order)
+        live: Dict[int, float] = {}
+        peak: Dict[int, float] = {}
+        alive_bytes: Dict[int, float] = {}
+        for tid in order:
+            n = self.dag.node(tid)
+            share = n.out_bytes / max(len(n.device_group), 1)
+            alive_bytes[tid] = share
+            for d in n.device_group:
+                live[d] = live.get(d, 0.0) + share
+                peak[d] = max(peak.get(d, 0.0), live[d])
+            for rid in n.mem_to_release:
+                r = self.dag.node(rid)
+                rshare = alive_bytes.get(rid, 0.0)
+                for d in r.device_group:
+                    live[d] = live.get(d, 0.0) - rshare
+        return peak
