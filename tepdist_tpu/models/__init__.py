@@ -1,0 +1,3 @@
+from tepdist_tpu.models import gpt2, gpt_moe, mlp, wide_resnet
+
+__all__ = ["gpt2", "gpt_moe", "mlp", "wide_resnet"]

@@ -1,0 +1,160 @@
+"""GPT-2 model family in pure JAX (pytree params, planner-friendly einsums).
+
+Reference parity: ``examples/GPT2`` (reference: examples/GPT2/models/gpt2/
+gpt2.py, configs 117M/345M/1.5B/175B in examples/GPT2/*.json). The reference
+feeds a TF-1.x GPT-2 graph to the planner; here the model is written
+jax-first: bfloat16 activations for the MXU, einsum attention whose
+dot_generals expose clean batch/head/sequence/model dims to the cone planner,
+static causal masking (no dynamic shapes), and a fused next-token
+cross-entropy loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_ctx: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dtype: Any = jnp.bfloat16
+    # Reference config names (examples/GPT2/{117M,345M,1.5B,175B}.json).
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+
+CONFIGS: Dict[str, GPT2Config] = {
+    "117M": GPT2Config(n_embd=768, n_layer=12, n_head=12),
+    "345M": GPT2Config(n_embd=1024, n_layer=24, n_head=16),
+    "762M": GPT2Config(n_embd=1280, n_layer=36, n_head=20),
+    "1.5B": GPT2Config(n_embd=1600, n_layer=48, n_head=25),
+    "175B": GPT2Config(n_embd=12288, n_layer=96, n_head=96, n_ctx=2048),
+    # tiny config for tests
+    "test": GPT2Config(vocab_size=512, n_ctx=64, n_embd=64, n_layer=2,
+                       n_head=4, dtype=jnp.float32),
+}
+
+
+def num_params(cfg: GPT2Config) -> int:
+    d, L, v = cfg.n_embd, cfg.n_layer, cfg.vocab_size
+    per_layer = 12 * d * d + 13 * d
+    return v * d + cfg.n_ctx * d + L * per_layer + 2 * d
+
+
+def init_params(cfg: GPT2Config, key) -> Dict[str, Any]:
+    """Initializer specs follow GPT-2: normal(0.02), residual projections
+    scaled by 1/sqrt(2*n_layer)."""
+    std = 0.02
+    resid_std = std / math.sqrt(2 * cfg.n_layer)
+    d = cfg.n_embd
+    keys = jax.random.split(key, 4 + cfg.n_layer)
+    f32 = jnp.float32
+
+    def norm(k, shape, s):
+        return (jax.random.normal(k, shape, f32) * s).astype(cfg.dtype)
+
+    params: Dict[str, Any] = {
+        "wte": norm(keys[0], (cfg.vocab_size, d), std),
+        "wpe": norm(keys[1], (cfg.n_ctx, d), std),
+        "ln_f_g": jnp.ones((d,), f32),
+        "ln_f_b": jnp.zeros((d,), f32),
+    }
+    for i in range(cfg.n_layer):
+        lk = jax.random.split(keys[4 + i], 4)
+        params[f"h{i}"] = {
+            "ln1_g": jnp.ones((d,), f32),
+            "ln1_b": jnp.zeros((d,), f32),
+            "attn_qkv_w": norm(lk[0], (d, 3 * d), std),
+            "attn_qkv_b": jnp.zeros((3 * d,), cfg.dtype),
+            "attn_proj_w": norm(lk[1], (d, d), resid_std),
+            "attn_proj_b": jnp.zeros((d,), cfg.dtype),
+            "ln2_g": jnp.ones((d,), f32),
+            "ln2_b": jnp.zeros((d,), f32),
+            "mlp_fc_w": norm(lk[2], (d, 4 * d), std),
+            "mlp_fc_b": jnp.zeros((4 * d,), cfg.dtype),
+            "mlp_proj_w": norm(lk[3], (4 * d, d), resid_std),
+            "mlp_proj_b": jnp.zeros((d,), cfg.dtype),
+        }
+    return params
+
+
+def _layer_norm(x, g, b, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * g + b).astype(x.dtype)
+
+
+def attention(block, x, cfg: GPT2Config, attn_impl=None):
+    B, T, D = x.shape
+    H, hd = cfg.n_head, cfg.head_dim
+    qkv = x @ block["attn_qkv_w"] + block["attn_qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+    if attn_impl is not None:
+        o = attn_impl(q, k, v)
+    else:
+        scale = 1.0 / math.sqrt(hd)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        logits = jnp.where(mask, logits.astype(jnp.float32), -1e9)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    o = o.transpose(0, 2, 1, 3).reshape(B, T, D)
+    return o @ block["attn_proj_w"] + block["attn_proj_b"]
+
+
+def mlp(block, x):
+    h = x @ block["mlp_fc_w"] + block["mlp_fc_b"]
+    h = jax.nn.gelu(h)
+    return h @ block["mlp_proj_w"] + block["mlp_proj_b"]
+
+
+def transformer_block(block, x, cfg: GPT2Config, attn_impl=None):
+    x = x + attention(block, _layer_norm(x, block["ln1_g"], block["ln1_b"]),
+                      cfg, attn_impl)
+    x = x + mlp(block, _layer_norm(x, block["ln2_g"], block["ln2_b"]))
+    return x
+
+
+def forward(params, tokens, cfg: GPT2Config, attn_impl=None):
+    """tokens: int32 [B, T] -> logits [B, T, vocab] (fp32)."""
+    B, T = tokens.shape
+    x = params["wte"][tokens] + params["wpe"][:T]
+    x = x.astype(cfg.dtype)
+    for i in range(cfg.n_layer):
+        x = transformer_block(params[f"h{i}"], x, cfg, attn_impl)
+    x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
+    return (x @ params["wte"].T).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, cfg: GPT2Config, attn_impl=None):
+    """Next-token cross entropy over shifted tokens (reference GPT2 LM loss)."""
+    logits = forward(params, tokens[:, :-1], cfg, attn_impl)
+    targets = tokens[:, 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def fake_batch(cfg: GPT2Config, batch_size: int, seq_len: Optional[int] = None,
+               seed: int = 0):
+    """FAKE_INPUT-mode batch (reference: fake_input configs / FAKE_INPUT env)."""
+    T = seq_len or cfg.n_ctx
+    key = jax.random.PRNGKey(seed)
+    return jax.random.randint(key, (batch_size, T + 1), 0, cfg.vocab_size,
+                              dtype=jnp.int32)

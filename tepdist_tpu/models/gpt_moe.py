@@ -1,0 +1,135 @@
+"""GPT-MoE: GPT blocks with GShard-style top-2 gated mixture-of-experts MLPs.
+
+Reference parity: ``examples/gpt_moe`` (reference:
+examples/gpt_moe/layers/moe_layers.py — top-2 gating, capacity-factor
+dispatch, einsum MoE whose graphs the planner turns into kDAPPLEAllToAll =
+expert parallelism). The TPU build expresses dispatch/combine as einsums over
+a static expert-capacity tensor, so sharding the expert dim over the
+``expert`` mesh axis makes GSPMD emit the two all-to-alls over ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from tepdist_tpu.models.gpt2 import GPT2Config, _layer_norm, attention
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    base: GPT2Config = GPT2Config()
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    moe_every: int = 2         # every k-th block uses MoE MLP
+
+
+CONFIGS: Dict[str, MoEConfig] = {
+    "base-8e": MoEConfig(base=GPT2Config(n_embd=768, n_layer=12, n_head=12),
+                         num_experts=8),
+    "test": MoEConfig(
+        base=GPT2Config(vocab_size=512, n_ctx=64, n_embd=64, n_layer=2,
+                        n_head=4, dtype=jnp.float32),
+        num_experts=4, moe_every=1),
+}
+
+
+def init_params(cfg: MoEConfig, key) -> Dict[str, Any]:
+    from tepdist_tpu.models.gpt2 import init_params as gpt_init
+
+    params = gpt_init(cfg.base, key)
+    d = cfg.base.n_embd
+    E = cfg.num_experts
+    std = 0.02
+    for i in range(cfg.base.n_layer):
+        if i % cfg.moe_every != 0:
+            continue
+        k1, k2, k3 = jax.random.split(jax.random.fold_in(key, 1000 + i), 3)
+        blk = params[f"h{i}"]
+        for name in ("mlp_fc_w", "mlp_fc_b", "mlp_proj_w", "mlp_proj_b"):
+            del blk[name]
+        blk["moe_gate_w"] = (jax.random.normal(k1, (d, E)) * std).astype(
+            cfg.base.dtype)
+        blk["moe_wi"] = (jax.random.normal(k2, (E, d, 4 * d)) * std).astype(
+            cfg.base.dtype)
+        blk["moe_wo"] = (jax.random.normal(k3, (E, 4 * d, d)) *
+                         std / math.sqrt(2 * cfg.base.n_layer)).astype(
+            cfg.base.dtype)
+    return params
+
+
+def moe_mlp(blk, x, cfg: MoEConfig):
+    """Top-2 gated MoE with capacity-limited einsum dispatch (GShard).
+
+    x: [B, T, D] -> [B, T, D]. The dispatch/combine einsums contract over
+    (tokens) and (experts, capacity): sharding E over the 'expert' mesh axis
+    turns them into all-to-alls.
+    """
+    B, T, D = x.shape
+    E = cfg.num_experts
+    S = B * T
+    C = max(int(cfg.capacity_factor * S * 2 / E), 1)
+    xf = x.reshape(S, D)
+
+    gate_logits = (xf @ blk["moe_gate_w"]).astype(jnp.float32)  # [S, E]
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+
+    # Top-2 expert choice per token.
+    g1, i1 = jax.lax.top_k(probs, 2)
+    w = g1 / (g1.sum(-1, keepdims=True) + 1e-9)                 # renormalize
+
+    # Position of each token within its expert's capacity buffer.
+    def one_hot_dispatch(idx, gate_w):
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)      # [S, E]
+        pos = jnp.cumsum(onehot, axis=0) * onehot               # rank in expert
+        keep = (pos <= C).astype(jnp.float32) * onehot
+        pos_clamped = jnp.minimum(pos - 1, C - 1).astype(jnp.int32)
+        cap_oh = jax.nn.one_hot(pos_clamped, C, dtype=jnp.float32)
+        # [S, E, C] dispatch mask weighted by gate
+        return keep[..., None] * cap_oh, keep * gate_w[:, None]
+
+    d1, k1_ = one_hot_dispatch(i1[:, 0], w[:, 0])
+    d2, k2_ = one_hot_dispatch(i1[:, 1], w[:, 1])
+    dispatch = d1 + d2                                           # [S, E, C]
+    combine = d1 * k1_.sum(-1)[:, None, None] + d2 * k2_.sum(-1)[:, None, None]
+
+    # Dispatch tokens -> expert buffers: [E, C, D] (all-to-all #1 when E is
+    # sharded over the expert axis).
+    xin = jnp.einsum("sec,sd->ecd", dispatch.astype(cfg.base.dtype), xf)
+    h = jnp.einsum("ecd,edf->ecf", xin, blk["moe_wi"])
+    h = jax.nn.gelu(h)
+    hout = jnp.einsum("ecf,efd->ecd", h, blk["moe_wo"])
+    # Combine back (all-to-all #2).
+    out = jnp.einsum("sec,ecd->sd", combine.astype(cfg.base.dtype), hout)
+    return out.reshape(B, T, D)
+
+
+def forward(params, tokens, cfg: MoEConfig):
+    base = cfg.base
+    B, T = tokens.shape
+    x = params["wte"][tokens] + params["wpe"][:T]
+    x = x.astype(base.dtype)
+    for i in range(base.n_layer):
+        blk = params[f"h{i}"]
+        x = x + attention(blk, _layer_norm(x, blk["ln1_g"], blk["ln1_b"]),
+                          base)
+        h_in = _layer_norm(x, blk["ln2_g"], blk["ln2_b"])
+        if "moe_gate_w" in blk:
+            x = x + moe_mlp(blk, h_in, cfg)
+        else:
+            from tepdist_tpu.models.gpt2 import mlp
+            x = x + mlp(blk, h_in)
+    x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
+    return (x @ params["wte"].T).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, cfg: MoEConfig):
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)

@@ -1,0 +1,80 @@
+"""Smoke-test models (reference: examples/smoke_testing/{simple,attention,
+conv}.py): a 1-matmul MLP, a single attention block, and a small conv net —
+the minimal graphs every layer of the framework is validated against."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp(key, din=32, dh=64, dout=8, depth=2, dtype=jnp.float32):
+    keys = jax.random.split(key, depth)
+    dims = [din] + [dh] * (depth - 1) + [dout]
+    return {
+        f"w{i}": (jax.random.normal(keys[i], (dims[i], dims[i + 1])) *
+                  (1.0 / math.sqrt(dims[i]))).astype(dtype)
+        for i in range(depth)
+    }
+
+
+def mlp_loss(params, x, y):
+    h = x
+    n = len(params)
+    for i in range(n):
+        h = h @ params[f"w{i}"]
+        if i < n - 1:
+            h = jax.nn.relu(h)
+    return jnp.mean((h - y) ** 2)
+
+
+def init_attention(key, d=64, heads=4, dtype=jnp.float32):
+    k1, k2 = jax.random.split(key)
+    return {
+        "qkv": (jax.random.normal(k1, (d, 3 * d)) / math.sqrt(d)).astype(dtype),
+        "proj": (jax.random.normal(k2, (d, d)) / math.sqrt(d)).astype(dtype),
+        "heads": heads,
+    }
+
+
+def attention_loss(params, x, y):
+    """One causal attention block + MSE (reference attention.py smoke test)."""
+    B, T, D = x.shape
+    H = params["heads"]
+    hd = D // H
+    qkv = x @ params["qkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    probs = jax.nn.softmax(jnp.where(mask, logits, -1e9), axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    o = o.transpose(0, 2, 1, 3).reshape(B, T, D)
+    out = o @ params["proj"]
+    return jnp.mean((out - y) ** 2)
+
+
+def init_conv(key, cin=3, cout=16, dtype=jnp.float32):
+    k1, k2 = jax.random.split(key)
+    return {
+        "conv_w": (jax.random.normal(k1, (3, 3, cin, cout)) * 0.1).astype(dtype),
+        "fc": (jax.random.normal(k2, (cout, 10)) * 0.1).astype(dtype),
+    }
+
+
+def conv_loss(params, x, y):
+    """Conv + pool + fc (reference conv.py smoke test). x: [B,H,W,C]."""
+    h = jax.lax.conv_general_dilated(
+        x, params["conv_w"], window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    h = jax.nn.relu(h)
+    h = h.mean(axis=(1, 2))
+    logits = h @ params["fc"]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, y[:, None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)

@@ -1,0 +1,121 @@
+"""Wide-ResNet family (reference: examples/wide_resnet/{resnet.py,config.py}:
+model_type 0-6 scaling 250M-13B params, fake-input benchmark mode).
+
+NHWC layout + bfloat16: the TPU conv path wants NHWC with channel counts in
+multiples of 128 for MXU tiling; BN is replaced by GroupNorm-style affine
+(batch-stat-free, so the graph stays cross-replica-sync-free under DP — the
+planner's GA decomposition requires it)."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class WRNConfig:
+    depth_per_stage: Tuple[int, ...] = (3, 4, 6, 3)
+    width: int = 128
+    widen: int = 2
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+
+# model_type 0-6 (reference examples/wide_resnet/README.md:21-31 — 250M..13B).
+CONFIGS: Dict[int, WRNConfig] = {
+    0: WRNConfig(width=128, widen=2),      # ~250M
+    1: WRNConfig(width=192, widen=2),
+    2: WRNConfig(width=256, widen=2),      # ~1B
+    3: WRNConfig(width=320, widen=2),
+    4: WRNConfig(width=384, widen=3),      # ~4B
+    5: WRNConfig(width=448, widen=3),
+    6: WRNConfig(width=512, widen=4),      # ~13B
+    -1: WRNConfig(depth_per_stage=(1, 1), width=16, widen=1, num_classes=10,
+                  dtype=jnp.float32),      # test config
+}
+
+
+def _conv_init(key, shape, dtype):
+    fan_in = math.prod(shape[:-1])
+    return (jax.random.normal(key, shape) / math.sqrt(fan_in)).astype(dtype)
+
+
+def init_params(cfg: WRNConfig, key) -> Dict[str, Any]:
+    params: Dict[str, Any] = {}
+    keys = iter(jax.random.split(key, 4 + 4 * sum(cfg.depth_per_stage) * 3))
+    c = cfg.width
+    params["stem"] = _conv_init(next(keys), (7, 7, 3, c), cfg.dtype)
+    for s, depth in enumerate(cfg.depth_per_stage):
+        cin = c * (2 ** max(s - 1, 0)) * (1 if s == 0 else cfg.widen)
+        cout = c * (2 ** s) * cfg.widen
+        cin = c if s == 0 else c * (2 ** (s - 1)) * cfg.widen
+        for b in range(depth):
+            ci = cin if b == 0 else cout
+            params[f"s{s}b{b}"] = {
+                "conv1": _conv_init(next(keys), (3, 3, ci, cout), cfg.dtype),
+                "g1": jnp.ones((cout,), jnp.float32),
+                "b1": jnp.zeros((cout,), jnp.float32),
+                "conv2": _conv_init(next(keys), (3, 3, cout, cout), cfg.dtype),
+                "g2": jnp.ones((cout,), jnp.float32),
+                "b2": jnp.zeros((cout,), jnp.float32),
+                "shortcut": (_conv_init(next(keys), (1, 1, ci, cout), cfg.dtype)
+                             if ci != cout else None),
+            }
+    c_final = c * (2 ** (len(cfg.depth_per_stage) - 1)) * cfg.widen
+    params["fc_w"] = _conv_init(next(keys), (c_final, cfg.num_classes),
+                                cfg.dtype)
+    params["fc_b"] = jnp.zeros((cfg.num_classes,), cfg.dtype)
+    return params
+
+
+def _norm_act(x, g, b):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(axis=(1, 2), keepdims=True)
+    var = x32.var(axis=(1, 2), keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+    return jax.nn.relu(y).astype(x.dtype)
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def forward(params, images, cfg: WRNConfig):
+    """images: [B, H, W, 3] -> logits [B, classes]."""
+    x = _conv(images.astype(cfg.dtype), params["stem"], stride=2)
+    for s, depth in enumerate(cfg.depth_per_stage):
+        for b in range(depth):
+            blk = params[f"s{s}b{b}"]
+            stride = 2 if (b == 0 and s > 0) else 1
+            h = _conv(x, blk["conv1"], stride)
+            h = _norm_act(h, blk["g1"], blk["b1"])
+            h = _conv(h, blk["conv2"])
+            sc = x if blk["shortcut"] is None else _conv(x, blk["shortcut"],
+                                                         stride)
+            x = _norm_act(h + sc, blk["g2"], blk["b2"])
+    pooled = x.mean(axis=(1, 2)).astype(jnp.float32)
+    return pooled @ params["fc_w"].astype(jnp.float32) + params[
+        "fc_b"].astype(jnp.float32)
+
+
+def loss_fn(params, images, labels, cfg: WRNConfig):
+    logits = forward(params, images, cfg)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def fake_batch(cfg: WRNConfig, batch_size: int, image_size: int = 224,
+               seed: int = 0):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    images = jax.random.normal(k1, (batch_size, image_size, image_size, 3),
+                               jnp.float32)
+    labels = jax.random.randint(k2, (batch_size,), 0, cfg.num_classes,
+                                dtype=jnp.int32)
+    return images, labels

@@ -1,0 +1,160 @@
+"""Model-family tests: forward/loss sanity + auto-parallel compatibility
+(reference: examples smoke tests asserted by loss values; here we assert
+losses are finite, decrease under training, and shard correctly)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tepdist_tpu.core.mesh import MeshTopology
+from tepdist_tpu.models import gpt2, gpt_moe, mlp, wide_resnet
+from tepdist_tpu.parallel.auto_parallel import auto_parallel
+
+
+def test_gpt2_forward_and_loss():
+    cfg = gpt2.CONFIGS["test"]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg, 4, 32)
+    loss = gpt2.loss_fn(params, tokens, cfg)
+    assert np.isfinite(float(loss))
+    # Initial loss close to ln(vocab) for random init.
+    assert abs(float(loss) - np.log(cfg.vocab_size)) < 1.5
+
+
+def test_gpt2_param_count_1p5b():
+    cfg = gpt2.CONFIGS["1.5B"]
+    n = gpt2.num_params(cfg)
+    assert 1.4e9 < n < 1.7e9
+
+
+def test_gpt2_trains():
+    cfg = gpt2.CONFIGS["test"]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg, 8, 32)
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o, t):
+        l, g = jax.value_and_grad(lambda p: gpt2.loss_fn(p, t, cfg))(p)
+        u, o = tx.update(g, o, p)
+        return l, optax.apply_updates(p, u), o
+
+    l0, params, opt = step(params, opt, tokens)
+    for _ in range(5):
+        l, params, opt = step(params, opt, tokens)
+    assert float(l) < float(l0)
+
+
+def test_gpt2_auto_parallel_dp(devices):
+    cfg = gpt2.CONFIGS["test"]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg, 8, 32)
+
+    def loss(p, t):
+        return gpt2.loss_fn(p, t, cfg)
+
+    topo = MeshTopology([("data", 8)])
+    plan = auto_parallel(jax.value_and_grad(loss), topo, params, tokens)
+    l_ref, _ = jax.value_and_grad(loss)(params, tokens)
+    l, _ = plan.step(params, tokens)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l_ref), rtol=1e-4)
+
+
+def test_wrn_forward_and_loss():
+    cfg = wide_resnet.CONFIGS[-1]
+    params = wide_resnet.init_params(cfg, jax.random.PRNGKey(0))
+    images, labels = wide_resnet.fake_batch(cfg, 4, image_size=32)
+    loss = wide_resnet.loss_fn(params, images, labels, cfg)
+    assert np.isfinite(float(loss))
+    assert abs(float(loss) - np.log(cfg.num_classes)) < 1.0
+
+
+def test_wrn_auto_parallel(devices):
+    cfg = wide_resnet.CONFIGS[-1]
+    params = wide_resnet.init_params(cfg, jax.random.PRNGKey(0))
+    images, labels = wide_resnet.fake_batch(cfg, 8, image_size=32)
+
+    def loss(p, im, lb):
+        return wide_resnet.loss_fn(p, im, lb, cfg)
+
+    topo = MeshTopology([("data", 8)])
+    plan = auto_parallel(jax.value_and_grad(loss), topo, params, images,
+                         labels)
+    l_ref, _ = jax.value_and_grad(loss)(params, images, labels)
+    l, _ = plan.step(params, images, labels)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l_ref), rtol=1e-4)
+
+
+def test_moe_forward_and_loss():
+    cfg = gpt_moe.CONFIGS["test"]
+    params = gpt_moe.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg.base, 4, 32)
+    loss = gpt_moe.loss_fn(params, tokens, cfg)
+    assert np.isfinite(float(loss))
+
+
+def test_moe_trains():
+    cfg = gpt_moe.CONFIGS["test"]
+    params = gpt_moe.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg.base, 8, 32)
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o, t):
+        l, g = jax.value_and_grad(lambda p: gpt_moe.loss_fn(p, t, cfg))(p)
+        u, o = tx.update(g, o, p)
+        return l, optax.apply_updates(p, u), o
+
+    l0, params, opt = step(params, opt, tokens)
+    for _ in range(5):
+        l, params, opt = step(params, opt, tokens)
+    assert float(l) < float(l0)
+
+
+def test_moe_expert_parallel_shardable(devices):
+    # Expert dim shardable over an 'expert' axis: rule-mode annotation on the
+    # expert weights must produce a valid executable matching unsharded.
+    from tepdist_tpu.core.dist_spec import DimStrategy
+
+    cfg = gpt_moe.CONFIGS["test"]
+    params = gpt_moe.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg.base, 4, 32)
+
+    def loss(p, t):
+        return gpt_moe.loss_fn(p, t, cfg)
+
+    flat, _ = jax.tree_util.tree_flatten((params, tokens))
+    topo = MeshTopology([("expert", 4)])
+    # Find flat indices of moe_wi/moe_wo ([E, d, f] 3D tensors).
+    ann = {}
+    leaves = jax.tree_util.tree_leaves(params)
+    for i, leaf in enumerate(leaves):
+        if leaf.ndim == 3 and leaf.shape[0] == cfg.num_experts:
+            ann[i] = {"expert": DimStrategy.split_on(0, 4)}
+    assert ann, "no expert weights found"
+    plan = auto_parallel(loss, topo, params, tokens, annotations=ann,
+                         mode="rule")
+    l_ref = loss(params, tokens)
+    l = plan.step(params, tokens)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l_ref), rtol=1e-4)
+
+
+def test_smoke_models():
+    k = jax.random.PRNGKey(0)
+    p = mlp.init_mlp(k)
+    x = jax.random.normal(k, (16, 32))
+    y = jnp.zeros((16, 8))
+    assert np.isfinite(float(mlp.mlp_loss(p, x, y)))
+
+    pa = mlp.init_attention(k)
+    xa = jax.random.normal(k, (2, 16, 64))
+    assert np.isfinite(float(mlp.attention_loss(pa, xa, xa)))
+
+    pc = mlp.init_conv(k)
+    xc = jax.random.normal(k, (4, 16, 16, 3))
+    yc = jnp.zeros((4,), jnp.int32)
+    assert np.isfinite(float(mlp.conv_loss(pc, xc, yc)))
