@@ -1,0 +1,130 @@
+"""Benchmark: GPT-2 training throughput with a fully automatic plan.
+
+North-star metric (BASELINE.md): tokens/sec/chip on GPT-2 with an auto plan,
+plus planner time-to-strategy. The reference publishes no numbers, so the
+baseline is self-measured: the first run writes ``bench_baseline.json`` and
+subsequent runs report the ratio against it.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_baseline.json")
+
+
+def main() -> None:
+    import optax
+
+    from tepdist_tpu.core.mesh import MeshTopology
+    from tepdist_tpu.models import gpt2
+    from tepdist_tpu.parallel.auto_parallel import auto_parallel
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform != "cpu"
+    if on_tpu:
+        cfg = gpt2.CONFIGS["117M"]
+        batch, seq, steps = 8, 512, 30
+        model_name = "gpt2_117m"
+    else:  # CPU fallback keeps the harness runnable anywhere
+        cfg = gpt2.CONFIGS["test"]
+        batch, seq, steps = 8, 32, 3
+        model_name = "gpt2_test"
+
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg, batch, seq)
+    tx = optax.adamw(1e-4, b1=0.9, b2=0.95, weight_decay=0.01)
+    opt_state = tx.init(params)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt2.loss_fn(p, tokens, cfg))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return loss, params, opt_state
+
+    n_dev = len(devices)
+    topo = MeshTopology([("data", n_dev)]) if n_dev > 1 else MeshTopology(
+        [("data", 1)])
+
+    n_state = len(jax.tree_util.tree_leaves((params, opt_state)))
+    state_alias = {1 + k: k for k in range(n_state)}  # outs=(loss, state...)
+    t_plan0 = time.perf_counter()
+    plan = auto_parallel(train_step, topo, params, opt_state, tokens,
+                         state_alias=state_alias)
+    step = plan.executable(devices=devices)
+    planner_seconds = time.perf_counter() - t_plan0
+
+    flat, _ = jax.tree_util.tree_flatten(((params, opt_state, tokens), {}))
+    # Commit inputs to the planned shardings up front so the jit signature
+    # (committed device arrays) is identical across all steps — one compile.
+    shardings = plan.input_shardings(devices)
+    flat = [jax.device_put(x, s) for x, s in zip(flat, shardings)]
+
+    def thread_state(flat, outs):
+        # outs = (loss, *new_params_leaves, *new_opt_leaves);
+        # flat = (*params_leaves, *opt_leaves, *token_leaves).
+        n = len(outs) - 1
+        return list(outs[1:]) + flat[n:]
+
+    # Warmup (compile) + one threaded step so the measured loop sees the
+    # steady-state signature.
+    outs = step(*flat)
+    _ = float(jax.device_get(outs[0]))  # real host round-trip barrier
+    flat = thread_state(flat, outs)
+    outs = step(*flat)
+    _ = float(jax.device_get(outs[0]))
+    flat = thread_state(flat, outs)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        outs = step(*flat)
+        flat = thread_state(flat, outs)
+    # block_until_ready is not a reliable barrier through the remote PJRT
+    # tunnel; fetching the loss to host is.
+    _ = float(jax.device_get(outs[0]))
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    tokens_per_sec_per_chip = tokens_per_sec / n_dev
+
+    metric = f"{model_name}_tokens_per_sec_per_chip"
+    baseline = None
+    if os.path.exists(BASELINE_FILE):
+        try:
+            data = json.load(open(BASELINE_FILE))
+            baseline = data.get(metric)
+        except Exception:
+            baseline = None
+    if baseline is None:
+        try:
+            data = {}
+            if os.path.exists(BASELINE_FILE):
+                data = json.load(open(BASELINE_FILE))
+            data[metric] = tokens_per_sec_per_chip
+            data[f"{metric}_planner_seconds"] = planner_seconds
+            json.dump(data, open(BASELINE_FILE, "w"), indent=1)
+        except Exception:
+            pass
+        baseline = tokens_per_sec_per_chip
+
+    print(json.dumps({
+        "metric": metric,
+        "value": round(tokens_per_sec_per_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tokens_per_sec_per_chip / baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

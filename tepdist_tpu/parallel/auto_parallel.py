@@ -124,9 +124,13 @@ def auto_parallel(
     *example_args,
     annotations: Optional[Dict[int, Dict[str, DimStrategy]]] = None,
     mode: Optional[str] = None,
+    state_alias: Optional[Dict[int, int]] = None,
     **example_kwargs,
 ) -> ParallelPlan:
-    """Plan ``fn`` over ``topology``. Modes: "cost" (default), "rule"."""
+    """Plan ``fn`` over ``topology``. Modes: "cost" (default), "rule".
+
+    ``state_alias``: outvar flat index -> invar flat index for training-state
+    threading (forces matching shardings across steps)."""
     env = ServiceEnv.get()
     if mode is None:
         mode = "rule" if env.rule_mode else "cost"
@@ -135,7 +139,7 @@ def auto_parallel(
     graph, in_tree, out_tree = trace_graph(fn, *example_args, **example_kwargs)
     strategies = plan_axes(graph, topology, annotations, mode)
     xform = SpmdTransform(graph, topology)
-    sharding_plan = xform.lower(strategies)
+    sharding_plan = xform.lower(strategies, state_alias=state_alias)
     return ParallelPlan(
         graph=graph,
         topology=topology,

@@ -56,6 +56,8 @@ class PipelineProgram:
         S = self.num_stages
         fwd_fns = decomp.forward_fns()
 
+        batch_set = set(self.batch_flat_indices)
+
         def run(flat_args: Sequence[Any]):
             stage_inputs: List[Tuple] = [None] * S
             stage_outputs: List[Tuple] = [None] * S
@@ -104,6 +106,8 @@ class PipelineProgram:
                     src = m.input_def_map[pos]
                     if src[0] == "arg":
                         i = src[1]
+                        if i in batch_set:
+                            continue  # int batch args yield float0 cots
                         grads[i] = c if i not in grads else jax.tree_util.tree_map(
                             jnp.add, grads[i], c)
                     else:

@@ -76,7 +76,12 @@ class SpmdTransform:
         self.graph = graph
         self.topology = topology
 
-    def lower(self, strategies: Sequence[GraphStrategy]) -> ShardingPlan:
+    def lower(self, strategies: Sequence[GraphStrategy],
+              state_alias: Optional[Dict[int, int]] = None) -> ShardingPlan:
+        """``state_alias``: outvar index -> invar index for training-state
+        threading (reference input_output_alias_map_): the aliased output is
+        forced to its input's sharding so step N's outputs feed step N+1
+        without resharding."""
         combined = combine_axis_strategies(self.graph, strategies)
         in_specs = []
         for v in self.graph.invars:
@@ -95,6 +100,9 @@ class SpmdTransform:
                 out_specs.append(ts.partition_spec(len(a.aval.shape)))
             else:
                 out_specs.append(None)
+        for oi, ii in (state_alias or {}).items():
+            if oi < len(out_specs):
+                out_specs[oi] = in_specs[ii]
         constraints: Dict[Var, PartitionSpec] = {}
         for node in self.graph.nodes:
             if not node.is_compute_intensive():

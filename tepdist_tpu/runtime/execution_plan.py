@@ -190,6 +190,30 @@ def build_pipeline_task_dag(
         dag.add_edge(prev, ap, out_idx=0, arg_pos=0)
         dag.add_edge(dag.node(maps.input_tasks[s]), ap)
 
+    # Shared parameters (e.g. tied embeddings consumed by several stages):
+    # every sharing stage's final GA feeds the OWNER stage's APPLY so the
+    # owner applies the summed gradient exactly once.
+    param_stages: Dict[int, List[int]] = {}
+    for s in range(S):
+        mod = prog.stages[s]
+        for p in mod.param_positions():
+            i = mod.input_def_map[p][1]
+            if i in set(prog.batch_flat_indices):
+                continue
+            param_stages.setdefault(i, [])
+            if s not in param_stages[i]:
+                param_stages[i].append(s)
+    for i, stages_of_i in param_stages.items():
+        if len(stages_of_i) <= 1:
+            continue
+        owner = min(stages_of_i)
+        for t in stages_of_i:
+            if t == owner:
+                continue
+            dag.add_edge(dag.node(maps.ga_tasks[(t, M - 1)]),
+                         dag.node(maps.apply_tasks[owner]),
+                         out_idx=0, arg_pos=1 + t)
+
     merge = dag.add(TaskType.MERGE, "merge", device_group=())
     maps.merge_task = merge.id
     loss_stage = next(s for s in range(S)

@@ -72,8 +72,11 @@ class PipelineExecutable:
         self.dag.build_gc_plan(self.schedule.order)
         self.optimizer = optimizer
 
-        # Param ownership: flat invar idx -> owning stage.
+        # Param ownership: flat invar idx -> owning stage (first consumer).
+        # Shared params (tied embeddings) are broadcast to other consumers
+        # each step; their gradients are summed into the owner's APPLY.
         self.param_owner: Dict[int, int] = {}
+        self.param_stages: Dict[int, List[int]] = {}
         batch = set(prog.batch_flat_indices)
         for s in range(S):
             mod = prog.stages[s]
@@ -81,12 +84,11 @@ class PipelineExecutable:
                 i = mod.input_def_map[pos][1]
                 if i in batch:
                     continue
-                if i in self.param_owner and self.param_owner[i] != s:
-                    raise NotImplementedError(
-                        f"param invar {i} consumed by stages "
-                        f"{self.param_owner[i]} and {s}; cross-stage shared "
-                        "parameters need a broadcast task (not yet built)")
-                self.param_owner[i] = s
+                self.param_stages.setdefault(i, [])
+                if s not in self.param_stages[i]:
+                    self.param_stages[i].append(s)
+        for i, stages_of_i in self.param_stages.items():
+            self.param_owner[i] = min(stages_of_i)
 
         self._compile_payloads()
         # Server-held state.
@@ -111,6 +113,12 @@ class PipelineExecutable:
         self._stage_ppos: List[Tuple[int, ...]] = [
             tuple(p for p in prog.stages[s].param_positions()
                   if prog.stages[s].input_def_map[p][1] not in batch_set)
+            for s in range(S)
+        ]
+        # Graph invar index per GA-accumulator slot, per stage.
+        self._stage_pidx: List[Tuple[int, ...]] = [
+            tuple(prog.stages[s].input_def_map[p][1]
+                  for p in self._stage_ppos[s])
             for s in range(S)
         ]
 
@@ -197,6 +205,13 @@ class PipelineExecutable:
                        if self.param_owner[i] == s}
                 self.opt_states[s] = self.optimizer.init(sub)
 
+    def _stage_param(self, s: int, i: int):
+        """Param value for stage ``s``: owner's copy, broadcast if shared."""
+        val = self.var_store[i]
+        if self.param_owner.get(i, s) != s:
+            val = jax.device_put(val, self.stage_shardings[s])
+        return val
+
     def fetch_variables(self):
         assert self.params_tree is not None, "load_variables first"
         flat = [jax.device_get(self.var_store[i])
@@ -239,7 +254,7 @@ class PipelineExecutable:
                         val = jax.device_put(micro_slices[(m, i)],
                                              self.stage_shardings[s])
                     else:
-                        val = self.var_store[i]
+                        val = self._stage_param(s, i)
                     args.append(val)
                 else:
                     pid, oi = node.input_specs[pos]
@@ -285,7 +300,11 @@ class PipelineExecutable:
             elif tt == TaskType.APPLY:
                 (pid, oi) = node.input_specs[0]
                 acc = outputs[pid][oi]
-                self._apply_stage(s, acc, M)
+                extras = {}
+                for pos, (epid, eoi) in node.input_specs.items():
+                    if pos >= 1:
+                        extras[pos - 1] = outputs[epid][eoi]  # pos-1 = stage
+                self._apply_stage(s, acc, M, extras)
                 outputs[tid] = ()
             else:
                 outputs[tid] = ()
@@ -297,18 +316,30 @@ class PipelineExecutable:
         loss = sum(jax.device_get(l) for l in losses) / M
         return loss
 
-    def _apply_stage(self, s: int, acc: Tuple, M: int) -> None:
-        mod = self.prog.stages[s]
-        idxs = [mod.input_def_map[p][1] for p in self._stage_ppos[s]]
-        grads = {i: g / M for i, g in zip(idxs, acc)}
-        params = {i: self.var_store[i] for i in idxs}
+    def _apply_stage(self, s: int, acc: Tuple, M: int,
+                     extras: Optional[Dict[int, Tuple]] = None) -> None:
+        """Apply gradients for params OWNED by stage ``s``, summing shared
+        params' contributions from other stages' GA accumulators."""
+        idxs_all = self._stage_pidx[s]
+        owned = [i for i in idxs_all if self.param_owner[i] == s]
+        grads: Dict[int, Any] = {}
+        for i, g in zip(idxs_all, acc):
+            if self.param_owner[i] == s:
+                grads[i] = g
+        for t, eacc in (extras or {}).items():
+            for i, g in zip(self._stage_pidx[t], eacc):
+                if self.param_owner.get(i) == s and i in grads:
+                    grads[i] = jax.device_put(
+                        g, self.stage_shardings[s]) + grads[i]
+        grads = {i: g / M for i, g in grads.items()}
+        params = {i: self.var_store[i] for i in owned}
         if self.optimizer is None:
-            for i in idxs:
+            for i in owned:
                 self.var_store[i] = params[i] - 0.01 * grads[i]
             return
         updates, self.opt_states[s] = self.optimizer.update(
             grads, self.opt_states[s], params)
         import optax
         new_params = optax.apply_updates(params, updates)
-        for i in idxs:
+        for i in owned:
             self.var_store[i] = new_params[i]

@@ -125,3 +125,36 @@ def test_gc_plan_releases_buffers(prog):
     assert released, "GC plan empty"
     # No double-release.
     assert len(released) == len(set(released))
+
+
+def test_executor_shared_params_tied_embeddings(devices):
+    # GPT-2 ties wte between stage 0 (embedding) and the last stage (logits):
+    # the owner stage must apply the SUMMED gradient exactly once.
+    import optax
+    from tepdist_tpu.models import gpt2
+
+    cfg = gpt2.CONFIGS["test"]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg, 8, 32)
+
+    def loss(p, t):
+        return gpt2.loss_fn(p, t, cfg)
+
+    prog = plan_pipeline(loss, 2, 2, params, tokens)
+    tx = optax.sgd(0.1)
+    exe = PipelineExecutable(prog, devices=devices, optimizer=tx)
+    exe.load_variables(params)
+    l0 = exe.step(tokens)
+    got = exe.fetch_variables()
+
+    def apply_fn(pp, ss, g):
+        updates, ss = tx.update(g, ss, pp)
+        return optax.apply_updates(pp, updates), ss
+
+    ref_step = jax.jit(prog.reference_step(apply_fn))
+    ref_l, ref_p, _ = ref_step(params, tx.init(params), tokens)
+    np.testing.assert_allclose(l0, np.asarray(ref_l), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        got, jax.device_get(ref_p))
