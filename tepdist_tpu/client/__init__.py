@@ -1,0 +1,4 @@
+from tepdist_tpu.client.annotations import AnnotationBuilder, split
+from tepdist_tpu.client.session import TepdistSession
+
+__all__ = ["AnnotationBuilder", "split", "TepdistSession"]

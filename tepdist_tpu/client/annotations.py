@@ -1,0 +1,71 @@
+"""User sharding-annotation API.
+
+Reference parity: the ``xla_sharding`` Python API (reference:
+xla/experimental/xla_sharding/xla_sharding.py:28-334):
+``split(tensor, split_dimension, num_devices)``, ``replicate()``,
+``tile()``. Annotations feed the planner as user pins
+(``CostSpmdStrategy::ExtractUserSplit``); ``IGNORE_ANNOTATION`` drops them.
+
+The TPU build expresses annotations as {flat arg index -> {mesh axis:
+DimStrategy}} maps consumed by ``auto_parallel``/the RPC plan options; this
+module builds them ergonomically from pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from tepdist_tpu.core.dist_spec import DimStrategy
+
+
+class AnnotationBuilder:
+    """Collects per-leaf annotations over the example-args pytree."""
+
+    def __init__(self, *example_args):
+        self._leaves, self._treedef = jax.tree_util.tree_flatten(example_args)
+        self._paths = jax.tree_util.tree_flatten_with_path(example_args)[0]
+        self.annotations: Dict[int, Dict[str, DimStrategy]] = {}
+
+    def _find(self, predicate: Callable) -> list:
+        out = []
+        for i, (path, leaf) in enumerate(self._paths):
+            key = jax.tree_util.keystr(path)
+            if predicate(key, leaf):
+                out.append(i)
+        return out
+
+    # -- reference API ------------------------------------------------
+    def split(self, predicate, split_dimension: int, axis: str,
+              num_devices: int) -> "AnnotationBuilder":
+        """xla_sharding.split parity: pin a dim split on matching leaves.
+        ``predicate(path_str, leaf) -> bool``."""
+        for i in self._find(predicate):
+            self.annotations.setdefault(i, {})[axis] = DimStrategy.split_on(
+                split_dimension, num_devices)
+        return self
+
+    def replicate(self, predicate, axis: str,
+                  num_devices: int) -> "AnnotationBuilder":
+        for i in self._find(predicate):
+            self.annotations.setdefault(i, {})[axis] = (
+                DimStrategy.make_replicated(num_devices))
+        return self
+
+    def tile(self, predicate, assignments: Dict[str, tuple]
+             ) -> "AnnotationBuilder":
+        """Multi-axis tiling: {axis: (dim, num)} per matching leaf."""
+        for i in self._find(predicate):
+            for ax, (dim, num) in assignments.items():
+                self.annotations.setdefault(i, {})[ax] = (
+                    DimStrategy.split_on(dim, num))
+        return self
+
+    def build(self) -> Dict[int, Dict[str, DimStrategy]]:
+        return dict(self.annotations)
+
+
+def split(example_args, predicate, split_dimension, axis, num_devices):
+    return AnnotationBuilder(*example_args).split(
+        predicate, split_dimension, axis, num_devices).build()

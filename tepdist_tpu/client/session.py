@@ -1,0 +1,133 @@
+"""Client session: the JAX frontend over the RPC service.
+
+Reference parity: the modified TF client's compile/run flow
+(reference: jit/kernels/xla_ops.{h,cc}): XlaCompileOp sends the whole-graph
+module via BuildExecutionPlan; XlaRunOp separates data args from variable
+args, transfers variables ONCE (cached server-side handles —
+``VarsCacheInRemote``), per-step inputs each step, calls ExecutePlan, and
+fetches resource variables every ``FETCH_RESOURCE_VAR_STEPS`` steps.
+
+The JAX version traces ``step_fn(params, opt_state, *batch)`` client-side,
+serializes the inlined jaxpr, and lets the SERVER plan/compile/execute on
+its devices — the client needs no accelerator.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+from tepdist_tpu.core.service_env import ServiceEnv
+from tepdist_tpu.rpc.client import TepdistClient
+from tepdist_tpu.rpc.jaxpr_serde import serialize_closed_jaxpr
+
+
+class TepdistSession:
+    def __init__(self, address: Optional[str] = None,
+                 mesh_axes: Sequence = (), mode: str = "cost"):
+        self.client = TepdistClient(address)
+        self.mesh_axes = list(mesh_axes)
+        self.mode = mode
+        self.handle: Optional[int] = None
+        self._out_tree = None
+        self._state_tree = None
+        self._n_state = 0
+        self._batch_leaf_idx: Sequence[int] = ()
+        self._step_count = 0
+        self.fetch_every = ServiceEnv.get().fetch_resource_var_steps
+
+    # ------------------------------------------------------------------
+    def compile_train_step(self, step_fn: Callable, params, opt_state,
+                           *example_batch,
+                           annotations: Optional[dict] = None) -> Dict:
+        """Trace + ship the whole training step; transfer initial state.
+
+        ``step_fn(params, opt_state, *batch) -> (loss, params, opt_state)``.
+        """
+        closed, out_shape = jax.make_jaxpr(step_fn, return_shape=True)(
+            params, opt_state, *example_batch)
+        module = serialize_closed_jaxpr(closed)
+
+        state_leaves = jax.tree_util.tree_leaves((params, opt_state))
+        self._state_tree = jax.tree_util.tree_structure((params, opt_state))
+        self._params_tree = jax.tree_util.tree_structure(params)
+        self._n_params = len(jax.tree_util.tree_leaves(params))
+        self._n_state = len(state_leaves)
+        n_batch = len(jax.tree_util.tree_leaves(example_batch))
+        self._batch_leaf_idx = list(range(self._n_state,
+                                          self._n_state + n_batch))
+        self._out_tree = jax.tree_util.tree_structure(out_shape)
+
+        # outs = (loss, new_params..., new_opt...) -> alias onto state invars
+        state_alias = {1 + k: k for k in range(self._n_state)}
+
+        ann_wire = None
+        if annotations:
+            ann_wire = {
+                str(i): {ax: {"partition_dim": s.partition_dim,
+                              "num_splits": s.num_splits,
+                              "partial": s.partial,
+                              "replicated": s.replicated}
+                         for ax, s in spec.items()}
+                for i, spec in annotations.items()
+            }
+        resp = self.client.build_execution_plan(
+            module,
+            mesh_axes=self.mesh_axes,
+            variable_indices=list(range(self._n_state)),
+            state_alias=state_alias,
+            mode=self.mode,
+            annotations=ann_wire,
+        )
+        self.handle = resp["handle"]
+
+        # Variables transferred once; server holds them across steps.
+        for i, leaf in enumerate(state_leaves):
+            self.client.transfer_to_server_host(np.asarray(leaf), i,
+                                                variable=True)
+        self.client.transfer_var_arg_map(
+            {i: i for i in range(self._n_state)})
+        return resp["summary"]
+
+    # ------------------------------------------------------------------
+    def run(self, *batch) -> float:
+        """One training step: per-step inputs ride inline with ExecutePlan
+        (reference: per-step TransferToServerHost + ExecutePlan)."""
+        assert self.handle is not None, "compile_train_step first"
+        leaves = jax.tree_util.tree_leaves(batch)
+        inline = {idx: np.asarray(v)
+                  for idx, v in zip(self._batch_leaf_idx, leaves)}
+        fetch = (self.fetch_every > 0 and
+                 (self._step_count + 1) % self.fetch_every == 0)
+        result = self.client.execute_plan(
+            self.handle, inline_args=inline,
+            fetch_resource_variables=fetch)
+        self._step_count += 1
+        loss = result["outputs"][0]
+        return float(np.asarray(loss))
+
+    # ------------------------------------------------------------------
+    def variables(self):
+        """Fetch (params, opt_state) back from the server
+        (reference FetchResourceVars)."""
+        fetched = self.client.fetch_resource_vars(
+            list(range(self._n_state)))
+        leaves = [fetched[i] for i in range(self._n_state)]
+        return jax.tree_util.tree_unflatten(self._state_tree, leaves)
+
+    def params(self):
+        state = self.variables()
+        return state[0]
+
+    def save(self, max_to_keep: int = 5) -> None:
+        self.client.do_remote_save(max_to_keep=max_to_keep)
+
+    def restore(self, global_step: int = -1) -> None:
+        self.client.do_remote_restore(global_step=global_step)
+
+    def close(self) -> None:
+        self.client.close()

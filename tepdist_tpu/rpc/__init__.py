@@ -1,0 +1,7 @@
+from tepdist_tpu.rpc import protocol
+from tepdist_tpu.rpc.jaxpr_serde import (
+    deserialize_closed_jaxpr,
+    serialize_closed_jaxpr,
+)
+
+__all__ = ["protocol", "serialize_closed_jaxpr", "deserialize_closed_jaxpr"]

@@ -1,0 +1,154 @@
+"""Client library: gRPC stub + Client over the Tepdist service.
+
+Reference parity: ``GRPCStub`` / ``Client`` / ``ClientLibrary`` (reference:
+rpc/grpc_stub.{h,cc}, client/client.cc:287-410, client/client_library.cc:
+142-165): channel resolved from ``SERVER_IP``/``SERVER_PORT`` env vars with
+INT_MAX message sizes; methods mirror the TePDist RPC set.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tepdist_tpu.rpc import protocol
+
+
+class GRPCStub:
+    """Thin bytes-level stub over the channel."""
+
+    def __init__(self, address: Optional[str] = None):
+        import grpc
+
+        if address is None:
+            ip = os.environ.get("SERVER_IP", "127.0.0.1")
+            port = os.environ.get("SERVER_PORT", "2222")
+            address = f"{ip}:{port}"
+        self.address = address
+        self._channel = grpc.insecure_channel(
+            address, options=protocol.GRPC_OPTIONS)
+        self._methods = {
+            m: self._channel.unary_unary(
+                protocol.method_path(m),
+                request_serializer=None,
+                response_deserializer=None,
+            )
+            for m in protocol.METHODS
+        }
+
+    def call(self, method: str, payload: bytes, timeout: float = 300.0
+             ) -> bytes:
+        return self._methods[method](payload, timeout=timeout)
+
+    def wait_ready(self, timeout: float = 30.0) -> None:
+        import grpc
+        grpc.channel_ready_future(self._channel).result(timeout=timeout)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class TepdistClient:
+    """High-level client (reference ``Client``)."""
+
+    def __init__(self, address: Optional[str] = None):
+        self.stub = GRPCStub(address)
+
+    # -- lifecycle ----------------------------------------------------
+    def ping(self) -> Dict[str, Any]:
+        header, _ = protocol.unpack(self.stub.call("Ping", protocol.pack({})))
+        return header
+
+    def wait_ready(self, timeout: float = 30.0) -> None:
+        self.stub.wait_ready(timeout)
+
+    # -- plan building --------------------------------------------------
+    def build_execution_plan(
+        self,
+        module_bytes: bytes,
+        mesh_axes: Sequence = (),
+        variable_indices: Sequence[int] = (),
+        state_alias: Optional[Dict[int, int]] = None,
+        mode: str = "cost",
+        annotations: Optional[Dict[int, Dict[str, dict]]] = None,
+        share_dev_flags: Optional[Sequence[bool]] = None,
+    ) -> Dict[str, Any]:
+        options = {
+            "mesh_axes": [[a, n] for a, n in mesh_axes] or None,
+            "variable_indices": list(variable_indices),
+            "state_alias": {str(k): v for k, v in (state_alias or {}).items()},
+            "mode": mode,
+            "annotations": annotations,
+            "share_dev_flags": list(share_dev_flags) if share_dev_flags
+            else None,
+        }
+        resp = self.stub.call("BuildExecutionPlan",
+                              protocol.pack({"options": options},
+                                            [module_bytes]))
+        header, _ = protocol.unpack(resp)
+        return header
+
+    # -- data transfer ----------------------------------------------------
+    def transfer_to_server_host(self, value, global_idx: int,
+                                variable: bool = False) -> None:
+        meta, blob = protocol.encode_literal(np.asarray(value))
+        self.stub.call("TransferToServerHost", protocol.pack(
+            {"global_idx": global_idx, "variable": variable,
+             "literal": meta}, [blob]))
+
+    def transfer_var_arg_map(self, var_arg_map: Dict[int, int]) -> None:
+        self.stub.call("TransferVarArgMap", protocol.pack(
+            {"var_arg_map": {str(k): v for k, v in var_arg_map.items()}}))
+
+    # -- execution ----------------------------------------------------
+    def execute_plan(self, handle: int,
+                     inline_args: Optional[Dict[int, Any]] = None,
+                     fetch_resource_variables: bool = False
+                     ) -> Dict[str, Any]:
+        blobs: List[bytes] = []
+        inline, inline_meta = {}, {}
+        for idx, val in (inline_args or {}).items():
+            meta, blob = protocol.encode_literal(np.asarray(val))
+            inline[str(idx)] = len(blobs)
+            inline_meta[str(idx)] = meta
+            blobs.append(blob)
+        resp = self.stub.call("ExecutePlan", protocol.pack(
+            {"handle": handle, "inline": inline, "inline_meta": inline_meta,
+             "fetch_resource_variables": fetch_resource_variables}, blobs))
+        header, rblobs = protocol.unpack(resp)
+        outputs = [protocol.decode_literal(m, rblobs[i])
+                   for i, m in enumerate(header["outputs"])]
+        fetched = {
+            int(k): protocol.decode_literal(v["meta"], rblobs[v["blob"]])
+            for k, v in header.get("fetched", {}).items()
+        }
+        return {"outputs": outputs,
+                "output_indices": header["output_indices"],
+                "fetched": fetched,
+                "global_step": header["global_step"]}
+
+    def fetch_resource_vars(self, indices: Optional[Sequence[int]] = None
+                            ) -> Dict[int, np.ndarray]:
+        resp = self.stub.call("FetchResourceVars", protocol.pack(
+            {"indices": list(indices) if indices is not None else None}))
+        header, blobs = protocol.unpack(resp)
+        return {int(m["global_idx"]): protocol.decode_literal(m, blobs[i])
+                for i, m in enumerate(header["vars"])}
+
+    # -- checkpoint ----------------------------------------------------
+    def do_remote_save(self, max_to_keep: int = 5,
+                       global_step: Optional[int] = None,
+                       lazy: bool = False) -> None:
+        self.stub.call("DoRemoteSave", protocol.pack(
+            {"max_to_keep": max_to_keep, "global_step": global_step,
+             "lazy": lazy}))
+
+    def do_remote_restore(self, global_step: int = -1,
+                          lazy: bool = False) -> None:
+        self.stub.call("DoRemoteRestore", protocol.pack(
+            {"global_step": global_step, "lazy": lazy}))
+
+    def close(self) -> None:
+        self.stub.close()

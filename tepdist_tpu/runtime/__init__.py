@@ -1,0 +1,16 @@
+from tepdist_tpu.runtime.checkpoint import CheckpointUtil
+from tepdist_tpu.runtime.execution_plan import build_pipeline_task_dag
+from tepdist_tpu.runtime.executor import PipelineExecutable
+from tepdist_tpu.runtime.task_graph import TaskDAG, TaskNode, TaskType
+from tepdist_tpu.runtime.task_scheduler import ScheduleResult, TaskScheduler
+
+__all__ = [
+    "CheckpointUtil",
+    "build_pipeline_task_dag",
+    "PipelineExecutable",
+    "TaskDAG",
+    "TaskNode",
+    "TaskType",
+    "ScheduleResult",
+    "TaskScheduler",
+]

@@ -1,0 +1,122 @@
+"""Wire-format round-trip tests: serialized jaxprs must evaluate identically
+(reference: HloModuleProto round-trip via TransferModuleAndDefCtx)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from jax.extend import core as jexcore
+
+from tepdist_tpu.rpc.jaxpr_serde import (
+    deserialize_closed_jaxpr,
+    deserialize_leaves,
+    serialize_closed_jaxpr,
+    serialize_pytree_leaves,
+)
+
+
+def _round_trip_eval(fn, *args):
+    closed = jax.make_jaxpr(fn)(*args)
+    data = serialize_closed_jaxpr(closed)
+    back = deserialize_closed_jaxpr(data)
+    flat = jax.tree_util.tree_leaves(args)
+    expected = jax.core.eval_jaxpr if False else None
+    # Evaluate both through the interpreter path.
+    from jax.extend.core import jaxpr_as_fun
+
+    out_ref = jaxpr_as_fun(jexcore.ClosedJaxpr(
+        __import__("tepdist_tpu.graph.jaxpr_graph",
+                   fromlist=["inline_calls"]).inline_calls(closed.jaxpr),
+        closed.consts))(*flat)
+    out_back = jaxpr_as_fun(back)(*flat)
+    for a, b in zip(out_ref, out_back):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    return len(data)
+
+
+def test_mlp_grad_round_trip():
+    def loss(w, x):
+        return jnp.mean((jax.nn.relu(x @ w)) ** 2)
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (16, 8))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+    _round_trip_eval(jax.value_and_grad(loss), w, x)
+
+
+def test_gpt2_train_step_round_trip():
+    from tepdist_tpu.models import gpt2
+
+    cfg = gpt2.CONFIGS["test"]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg, 2, 16)
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    def step(p, o, t):
+        l, g = jax.value_and_grad(lambda p: gpt2.loss_fn(p, t, cfg))(p)
+        u, o = tx.update(g, o, p)
+        return l, optax.apply_updates(p, u), o
+
+    size = _round_trip_eval(step, params, opt, tokens)
+    assert size > 0
+
+
+def test_scan_ga_round_trip():
+    # lax.scan with nested jaxpr params must survive the wire.
+    def f(c, xs):
+        def body(c, x):
+            return c + x @ x, c.sum()
+        return jax.lax.scan(body, c, xs)
+
+    c = jnp.eye(4)
+    xs = jax.random.normal(jax.random.PRNGKey(0), (3, 4, 4))
+    _round_trip_eval(f, c, xs)
+
+
+def test_conv_round_trip():
+    from tepdist_tpu.models import mlp
+
+    p = mlp.init_conv(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8, 3))
+    y = jnp.zeros((2,), jnp.int32)
+    _round_trip_eval(jax.grad(mlp.conv_loss), p, x, y)
+
+
+def test_moe_round_trip():
+    from tepdist_tpu.models import gpt2, gpt_moe
+
+    cfg = gpt_moe.CONFIGS["test"]
+    params = gpt_moe.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg.base, 2, 16)
+    _round_trip_eval(lambda p, t: gpt_moe.loss_fn(p, t, cfg), params, tokens)
+
+
+def test_planner_runs_on_deserialized_module():
+    # The server-side flow: receive bytes -> JaxprGraph -> plan.
+    from tepdist_tpu.core.mesh import MeshTopology
+    from tepdist_tpu.graph.jaxpr_graph import JaxprGraph
+    from tepdist_tpu.parallel.auto_parallel import plan_axes
+
+    def loss(w, x):
+        return jnp.mean((x @ w) ** 2)
+
+    w = jax.ShapeDtypeStruct((1024, 1024), jnp.float32)
+    x = jax.ShapeDtypeStruct((8192, 1024), jnp.float32)
+    closed = jax.make_jaxpr(jax.grad(loss))(w, x)
+    back = deserialize_closed_jaxpr(serialize_closed_jaxpr(closed))
+    graph = JaxprGraph(back, inline=False)
+    strategies = plan_axes(graph, MeshTopology([("data", 8)]))
+    assert strategies and strategies[0].var_strategies
+
+
+def test_leaves_transfer():
+    tree = {"a": jnp.arange(6).reshape(2, 3), "b": jnp.float32(1.5)}
+    data, treedef = serialize_pytree_leaves(tree)
+    leaves = deserialize_leaves(data)
+    back = jax.tree_util.tree_unflatten(treedef, leaves)
+    np.testing.assert_array_equal(np.asarray(back["a"]),
+                                  np.asarray(tree["a"]))
+    assert float(back["b"]) == 1.5
