@@ -1,0 +1,102 @@
+"""Pallas TPU flash attention kernel.
+
+The intra-device hot op: online-softmax blockwise attention computed in VMEM
+(one pass over K/V blocks per Q block), MXU-shaped [block, head_dim] matmuls,
+fp32 accumulators. Usable standalone, as the ``inner`` of Ulysses sequence
+parallelism, or as the per-block compute of ring attention.
+
+Runs in interpret mode off-TPU (tests), compiled on TPU. Reference parity:
+none — the reference has no fused attention at all (SURVEY.md §5.7); this is
+TPU-native surplus.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float, q_block: int, seq_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+    bq, D = q.shape
+
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    o0 = jnp.zeros((bq, D), jnp.float32)
+
+    n_blocks = seq_len // block_k
+
+    def body(j, carry):
+        m, l, o = carry
+        k = k_ref[0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
+        s = q @ k.T                                   # [bq, bk]
+        if causal:
+            qpos = qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_blk = s.max(axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(m_new <= _NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(m - m_new)
+        corr = jnp.where(m <= _NEG_INF / 2, 0.0, corr)
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        o_new = o * corr + p @ v
+        return m_new, l_new, o_new
+
+    if causal:
+        # Only blocks up to (and including) the diagonal contribute.
+        hi = jnp.minimum(((qi + 1) * q_block + block_k - 1) // block_k,
+                         n_blocks)
+    else:
+        hi = n_blocks
+    m, l, o = jax.lax.fori_loop(0, hi, body, (m0, l0, o0))
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """q, k, v: [B, H, T, D] -> [B, H, T, D]."""
+    B, H, T, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        raise ValueError(f"seq len {T} must divide blocks {block_q}/{block_k}")
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, T, D)
+    vf = v.reshape(B * H, T, D)
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, scale=scale,
+        q_block=block_q, seq_len=T)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, T, D)

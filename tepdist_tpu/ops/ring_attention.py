@@ -1,0 +1,108 @@
+"""Ring attention: sequence/context parallelism over an ICI ring.
+
+Reference parity: NONE — the reference only expresses "token parallel" as a
+generic dim split (SURVEY.md §5.7) and has no ring attention, blockwise
+attention, or LSE merging. This is a first-class TPU-native addition: the
+sequence axis is sharded over a mesh axis; each step computes blockwise
+attention against the resident K/V block with online-softmax (LSE) merging
+while `lax.ppermute` rotates K/V blocks around the ring — one ICI neighbor
+hop per step, so communication is fully overlappable with the block matmuls
+(cf. Liu et al., Ring Attention with Blockwise Transformers, arXiv:2310.01889).
+
+Layout: q, k, v are [B, H, T, D] with T sharded over ``axis_name``; inside
+``shard_map`` each device sees its local [B, H, T/P, D] block.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, m, l, o, q_start, k_start, causal, scale):
+    """One online-softmax accumulation step against a K/V block."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        Tq, Tk = q.shape[2], k.shape[2]
+        qpos = q_start + jnp.arange(Tq)[:, None]
+        kpos = k_start + jnp.arange(Tk)[None, :]
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    m_block = s.max(axis=-1, keepdims=True)                   # [B,H,Tq,1]
+    m_new = jnp.maximum(m, m_block)
+    # Guard fully-masked rows (m_new == -inf): keep exp at 0.
+    p = jnp.exp(s - m_new)
+    p = jnp.where(m_new <= _NEG_INF / 2, 0.0, p)
+    corr = jnp.exp(m - m_new)
+    corr = jnp.where(m <= _NEG_INF / 2, 0.0, corr)
+    l_new = l * corr + p.sum(axis=-1, keepdims=True)
+    o_new = o * corr + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return m_new, l_new, o_new
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          scale: Optional[float]):
+    """Per-device body (runs under shard_map)."""
+    P_ = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Tl, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    m0 = jnp.full((B, H, Tl, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl, 1), jnp.float32)
+    o0 = jnp.zeros((B, H, Tl, D), jnp.float32)
+    # Mark the accumulators as device-varying over the ring axis so the
+    # fori_loop carry types match (shard_map varying-axis typing).
+    m0, l0, o0 = (jax.lax.pvary(x, (axis_name,)) for x in (m0, l0, o0))
+
+    perm = [(i, (i + 1) % P_) for i in range(P_)]
+
+    def body(s, carry):
+        k_cur, v_cur, m, l, o = carry
+        j = (idx - s) % P_          # owner of the resident K/V block
+        m, l, o = _block_attention(
+            q, k_cur, v_cur, m, l, o,
+            q_start=idx * Tl, k_start=j * Tl, causal=causal, scale=scale)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m, l, o)
+
+    k_f, v_f, m, l, o = lax.fori_loop(0, P_, body, (k, v, m0, l0, o0))
+    out = o / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
+                   causal: bool = True, scale: Optional[float] = None):
+    """Sequence-parallel attention: [B, H, T, D] with T sharded over
+    ``axis_name`` of ``mesh``. Returns output with the same sharding."""
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(_ring_attention_local, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = True,
+                        scale: Optional[float] = None):
+    """Unsharded reference for testing."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        T = q.shape[2]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
