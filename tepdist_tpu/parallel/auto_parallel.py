@@ -151,6 +151,48 @@ def auto_parallel(
     )
 
 
+def auto_parallel_explore(
+    fn: Callable,
+    num_devices: int,
+    *example_args,
+    annotations: Optional[Dict[int, Dict[str, DimStrategy]]] = None,
+    state_alias: Optional[Dict[int, int]] = None,
+    num_micro_batches: int = 1,
+    **example_kwargs,
+) -> ParallelPlan:
+    """Exploration mode (reference: AutoParallel::RunExplorationlMode,
+    auto_parallel.cc:236): enumerate mesh-shape proposals
+    (GenerateSplitProposals), plan each, keep the Evaluator-minimal one."""
+    from tepdist_tpu.parallel.evaluator import Evaluator
+    from tepdist_tpu.parallel.spmd_transform import SpmdTransform as _Xform
+
+    graph, in_tree, out_tree = trace_graph(fn, *example_args, **example_kwargs)
+    best = None
+    for topo in explore_topologies(num_devices):
+        try:
+            strategies = plan_axes(graph, topo, annotations, "cost")
+        except Exception as e:  # infeasible proposal (e.g. indivisible dims)
+            log.info("proposal %s failed: %s", topo, e)
+            continue
+        cost = Evaluator(topo).run(graph, strategies, num_micro_batches)
+        log.info("proposal %s -> duration=%.3e feasible=%s",
+                 topo, cost.total_duration, cost.memory_feasible)
+        if best is None or cost.key() < best[0].key():
+            best = (cost, topo, strategies)
+    if best is None:
+        raise RuntimeError("no feasible topology proposal")
+    cost, topo, strategies = best
+    xform = _Xform(graph, topo)
+    sharding_plan = xform.lower(strategies, state_alias=state_alias)
+    plan = ParallelPlan(
+        graph=graph, topology=topo, strategies=strategies,
+        sharding_plan=sharding_plan, in_tree=in_tree, out_tree=out_tree,
+        mode="exploration",
+    )
+    plan.cost = cost
+    return plan
+
+
 def explore_topologies(
     num_devices: int, max_levels: int = 2
 ) -> List[MeshTopology]:

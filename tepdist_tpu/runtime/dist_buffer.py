@@ -1,0 +1,100 @@
+"""DistributedBuffer: the device-count-wide distributed tensor handle.
+
+Reference parity: ``DAPPLEBuffer`` (reference: pjrt/dapple_buffer.{h,cc} +
+dapple_buffer_utils): host raw value + per-device shards, placeholder
+creation (shape-only until materialized), host/device state flags, and
+H2D/D2H slice transfer.
+
+TPU-native: a sharded ``jax.Array`` already IS the per-device shard
+collection, so this class wraps one plus the host cache and
+placeholder/variable bookkeeping the service layer needs."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+
+class DistributedBuffer:
+    def __init__(self, shape: Tuple[int, ...], dtype,
+                 sharding=None, global_idx: int = -1,
+                 is_variable: bool = False):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype) if not hasattr(dtype, "name") else dtype
+        self.sharding = sharding
+        self.global_idx = global_idx
+        self.is_variable = is_variable
+        self._host: Optional[np.ndarray] = None
+        self._device: Optional[jax.Array] = None
+
+    # -- creation -------------------------------------------------------
+    @classmethod
+    def placeholder(cls, shape, dtype, sharding=None, global_idx=-1,
+                    is_variable=False) -> "DistributedBuffer":
+        """Shape-only buffer (reference placeholder creation): materialized
+        later by server-side init or a transfer."""
+        return cls(shape, dtype, sharding, global_idx, is_variable)
+
+    @classmethod
+    def from_host(cls, value, sharding=None, global_idx=-1,
+                  is_variable=False) -> "DistributedBuffer":
+        arr = np.asarray(value)
+        buf = cls(arr.shape, arr.dtype, sharding, global_idx, is_variable)
+        buf._host = arr
+        return buf
+
+    @classmethod
+    def from_device(cls, value: jax.Array, global_idx=-1,
+                    is_variable=False) -> "DistributedBuffer":
+        buf = cls(value.shape, value.dtype, value.sharding, global_idx,
+                  is_variable)
+        buf._device = value
+        return buf
+
+    # -- state flags ------------------------------------------------------
+    @property
+    def on_host(self) -> bool:
+        return self._host is not None
+
+    @property
+    def on_device(self) -> bool:
+        return self._device is not None
+
+    @property
+    def is_placeholder(self) -> bool:
+        return self._host is None and self._device is None
+
+    # -- movement ---------------------------------------------------------
+    def device_value(self) -> jax.Array:
+        if self._device is None:
+            if self._host is None:
+                raise ValueError("placeholder buffer not materialized")
+            self._device = (jax.device_put(self._host, self.sharding)
+                            if self.sharding is not None
+                            else jax.device_put(self._host))
+        return self._device
+
+    def host_value(self) -> np.ndarray:
+        if self._host is None:
+            if self._device is None:
+                raise ValueError("placeholder buffer not materialized")
+            self._host = np.asarray(jax.device_get(self._device))
+        return self._host
+
+    def update_device(self, value: jax.Array) -> None:
+        self._device = value
+        self._host = None  # stale
+
+    def addressable_shards(self):
+        return self.device_value().addressable_shards
+
+    def __repr__(self):
+        state = ("placeholder" if self.is_placeholder else
+                 "+".join(s for s, ok in
+                          (("host", self.on_host), ("device", self.on_device))
+                          if ok))
+        return (f"DistributedBuffer(shape={self.shape}, "
+                f"dtype={self.dtype}, {state}, var={self.is_variable})")

@@ -1,0 +1,176 @@
+"""State-subsystem tests: sharded init (slice-for-slice equality — the
+reference's initializers_test contract), slice utils (reference
+slice_utils_test), variable specs, distributed buffer, cluster spec,
+resolve/affinity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tepdist_tpu.core.cluster_spec import ClusterSpec
+from tepdist_tpu.core.dist_spec import DimStrategy, TensorStrategy
+from tepdist_tpu.core.mesh import MeshTopology
+from tepdist_tpu.runtime.dist_buffer import DistributedBuffer
+from tepdist_tpu.runtime.initializers import init_from_spec, shard_consistent_init
+from tepdist_tpu.runtime.slice_utils import (
+    assemble_from_slices,
+    slice_copy_on_host,
+    slice_start_offsets,
+)
+from tepdist_tpu.runtime.variable_specs import VariableSpecsMgr
+
+
+def test_sharded_init_slice_equals_full(devices):
+    """The reference's initializers_test contract: sharded fill == full
+    fill, slice for slice, across shard dims and prime-ish sizes."""
+    mesh = Mesh(np.array(devices[:4]).reshape(4), axis_names=("model",))
+    key = jax.random.PRNGKey(7)
+    for shape, spec in [((64, 36), P("model", None)),
+                        ((36, 64), P(None, "model")),
+                        ((8, 12, 16), P(None, "model", None))]:
+        full = shard_consistent_init(key, shape, jnp.float32, None)
+        sharded = shard_consistent_init(
+            key, shape, jnp.float32, NamedSharding(mesh, spec))
+        np.testing.assert_array_equal(np.asarray(sharded), np.asarray(full))
+        # Each device's shard equals the corresponding slice of the full.
+        for s in sharded.addressable_shards:
+            np.testing.assert_array_equal(
+                np.asarray(s.data), np.asarray(full)[s.index])
+
+
+def test_init_from_spec_distributions():
+    key = jax.random.PRNGKey(0)
+    for dist in ("normal", "uniform", "truncated_normal", "zeros", "ones"):
+        x = init_from_spec(key, {"shape": (16, 8), "dtype": "float32",
+                                 "distribution": dist, "scale": 0.5})
+        assert x.shape == (16, 8)
+        assert np.all(np.isfinite(np.asarray(x)))
+    fan = init_from_spec(key, {"shape": (100, 10), "distribution": "normal",
+                               "fan_in_scaling": True})
+    assert np.std(np.asarray(fan)) < 0.2  # ~1/sqrt(100)
+
+
+def test_slice_utils_round_trip():
+    topo = MeshTopology([("data", 2), ("model", 4)])
+    ts = TensorStrategy({"data": DimStrategy.split_on(0, 2),
+                         "model": DimStrategy.split_on(1, 4)})
+    src = np.arange(8 * 16, dtype=np.float32).reshape(8, 16)
+    shards = {d: slice_copy_on_host(src, ts, topo, d) for d in range(8)}
+    assert all(s.shape == (4, 4) for s in shards.values())
+    back = assemble_from_slices((8, 16), ts, topo, shards)
+    np.testing.assert_array_equal(back, src)
+
+
+def test_slice_offsets_replicated_axis():
+    topo = MeshTopology([("data", 2), ("model", 4)])
+    ts = TensorStrategy({"model": DimStrategy.split_on(0, 4)})  # data repl.
+    offs0 = slice_start_offsets((16, 8), ts, topo, 0)
+    assert offs0 == ((0, 4), (0, 8))
+    # Devices differing only in data coord hold identical slices.
+    d_a = slice_start_offsets((16, 8), ts, topo, 1)
+    d_b = slice_start_offsets((16, 8), ts, topo, 5)
+    assert d_a == d_b
+
+
+def test_variable_specs_unique_writers():
+    topo = MeshTopology([("data", 2), ("model", 4)])
+    mgr = VariableSpecsMgr(topo)
+    ts = TensorStrategy({"model": DimStrategy.split_on(0, 4)})
+    spec = mgr.derive(0, (16, 8), "float32", ts)
+    assert spec.local_shape == (4, 8)
+    writers = mgr.unique_slice_devices(0)
+    assert len(writers) == 4  # one per distinct slice
+
+
+def test_distributed_buffer_lifecycle(devices):
+    buf = DistributedBuffer.placeholder((4, 4), np.float32)
+    assert buf.is_placeholder
+    with pytest.raises(ValueError):
+        buf.device_value()
+    buf2 = DistributedBuffer.from_host(np.eye(4, dtype=np.float32))
+    dv = buf2.device_value()
+    assert buf2.on_device and buf2.on_host
+    buf2.update_device(dv + 1)
+    np.testing.assert_array_equal(buf2.host_value(),
+                                  np.eye(4, dtype=np.float32) + 1)
+
+
+def test_cluster_spec_parsing():
+    raw = """{"workers": [
+      {"ip": "10.0.0.1", "port": 2222, "gpu_ids": [0, 1, 2, 3]},
+      {"ip": "10.0.0.2", "port": 2222, "device_ids": [0, 1, 2, 3]}
+    ]}"""
+    spec = ClusterSpec.from_json(raw)
+    assert spec.num_workers == 2
+    assert spec.total_devices == 8
+    assert spec.master.ip == "10.0.0.1"
+    assert spec.global_device_id(1, 0) == 4
+    assert spec.worker_of_device(5).ip == "10.0.0.2"
+    back = ClusterSpec.from_json(spec.to_json())
+    assert back.total_devices == 8
+
+
+def test_resolve_forward_backward_apply():
+    from tepdist_tpu.graph.jaxpr_graph import trace_graph
+    from tepdist_tpu.parallel.resolve_utils import (
+        resolve_forward_backward_apply,
+    )
+
+    def loss_fn(params, x, y):
+        h = jax.nn.relu(x @ params["w1"])
+        return jnp.mean((h @ params["w2"] - y) ** 2)
+
+    tx = optax.adam(1e-3)
+    k = jax.random.PRNGKey(0)
+    params = {"w1": jnp.zeros((8, 16)), "w2": jnp.zeros((16, 4))}
+    opt = tx.init(params)
+    x = jnp.zeros((32, 8))
+    y = jnp.zeros((32, 4))
+
+    def step(p, o, x, y):
+        l, g = jax.value_and_grad(loss_fn)(p, x, y)
+        u, o = tx.update(g, o, p)
+        return l, optax.apply_updates(p, u), o
+
+    graph, _, _ = trace_graph(step, params, opt, x, y)
+    n_state = len(jax.tree_util.tree_leaves((params, opt)))
+    state_alias = {1 + i: i for i in range(n_state)}
+    rr = resolve_forward_backward_apply(graph, state_alias=state_alias)
+    assert rr.forward_nodes and rr.backward_nodes and rr.apply_nodes
+    # Gradients found for both params (invars 0, 1), with matching shapes.
+    grad_idxs = set(rr.gradients)
+    assert 0 in grad_idxs and 1 in grad_idxs
+    assert rr.gradients[0].aval.shape == (8, 16)
+    assert rr.gradients[1].aval.shape == (16, 4)
+
+
+def test_affinity_groups_adam_slots():
+    from tepdist_tpu.graph.jaxpr_graph import trace_graph
+    from tepdist_tpu.parallel.inst_affinity import build_affinity_groups
+
+    def loss_fn(params, x, y):
+        h = jax.nn.relu(x @ params["w1"])
+        return jnp.mean((h @ params["w2"] - y) ** 2)
+
+    tx = optax.adam(1e-3)
+    params = {"w1": jnp.zeros((8, 16)), "w2": jnp.zeros((16, 4))}
+    opt = tx.init(params)
+    x = jnp.zeros((32, 8))
+    y = jnp.zeros((32, 4))
+
+    def step(p, o, x, y):
+        l, g = jax.value_and_grad(loss_fn)(p, x, y)
+        u, o = tx.update(g, o, p)
+        return l, optax.apply_updates(p, u), o
+
+    graph, _, _ = trace_graph(step, params, opt, x, y)
+    n_state = len(jax.tree_util.tree_leaves((params, opt)))
+    state_alias = {1 + i: i for i in range(n_state)}
+    groups = build_affinity_groups(graph, state_alias)
+    # w1 (shape 8x16) must group with its adam m/v slots (same shape).
+    g_w1 = [g for g in groups
+            if any(graph.invars[i].aval.shape == (8, 16) for i in g)]
+    assert g_w1 and len(g_w1[0]) >= 3  # param + m + v
