@@ -86,7 +86,62 @@ class TaskScheduler:
                 best = r
         return best
 
-    def _simulate(self, window: int) -> ScheduleResult:
+    def _simulate(self, window: int, use_native: Optional[bool] = None
+                  ) -> ScheduleResult:
+        if use_native is None:
+            use_native = len(self.dag.nodes) >= 256  # amortize call overhead
+        if use_native:
+            r = self._simulate_native(window)
+            if r is not None:
+                return r
+        return self._simulate_py(window)
+
+    def _simulate_native(self, window: int) -> Optional[ScheduleResult]:
+        """C++ simulation core (tepdist_tpu/native/scheduler.cc); produces
+        bit-identical schedules to the Python loop (tested)."""
+        from tepdist_tpu import native
+
+        dag = self.dag
+        kind, dur, stage, micro, groups, children, n_parents = (
+            [], [], [], [], [], [], [])
+        for n in dag.nodes:
+            if n.task_type == TaskType.COMPUTE and "bwd" in n.name:
+                kind.append(native.KIND_BWD)
+            elif n.task_type == TaskType.COMPUTE and "fwd" in n.name:
+                kind.append(native.KIND_FWD)
+            else:
+                kind.append(native.KIND_OTHER)
+            dur.append(self.task_time(n))
+            stage.append(n.stage)
+            micro.append(n.micro)
+            groups.append(list(n.device_group))
+            children.append(list(n.children))
+            n_parents.append(len(n.parents))
+        res = native.schedule_native(kind, dur, stage, micro, groups,
+                                     children, n_parents, window)
+        if res is None:
+            return None
+        order_a, start_a, finish_a = res
+        order = [int(t) for t in order_a]
+        start = {t: float(start_a[t]) for t in order}
+        finish = {t: float(finish_a[t]) for t in order}
+        per_device: Dict[Tuple[int, ...], List[int]] = {}
+        sim_busy: Dict[int, float] = {}
+        for t in order:
+            n = dag.node(t)
+            per_device.setdefault(tuple(n.device_group), []).append(t)
+            for d in n.device_group:
+                sim_busy[d] = sim_busy.get(d, 0.0) + (
+                    dur[t] if n.task_type == TaskType.COMPUTE else 0.0)
+        makespan = max(finish.values(), default=0.0)
+        peak = self._memory_account(order)
+        ndev = max(len({d for g in per_device for d in g}), 1)
+        bubble = (1.0 - sum(sim_busy.values()) / (ndev * makespan)
+                  if makespan > 0 else 0.0)
+        return ScheduleResult(order, per_device, start, finish, makespan,
+                              peak, bubble)
+
+    def _simulate_py(self, window: int) -> ScheduleResult:
         dag = self.dag
         indeg = {n.id: len(n.parents) for n in dag.nodes}
         dev_free: Dict[int, float] = {}
