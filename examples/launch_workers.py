@@ -1,0 +1,47 @@
+"""Launch tepdist servers from a cluster config (reference: launch_worker.sh
+— jq over config_*worker_template.json, sets CLUSTER_SPEC and starts
+grpc_service_gpu per worker). This Python version launches the local
+worker(s) of the config matching --task_index, or all localhost workers."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "..")))
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--task_index", type=int, default=-1,
+                        help="-1 = every localhost worker")
+    args = parser.parse_args()
+    with open(args.config) as f:
+        spec = json.load(f)
+    procs = []
+    for w in spec["workers"]:
+        if args.task_index >= 0 and w.get("task_index") != args.task_index:
+            continue
+        if args.task_index < 0 and w["ip"] not in ("127.0.0.1", "localhost"):
+            continue
+        env = dict(os.environ)
+        env["CLUSTER_SPEC"] = json.dumps(spec)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "tepdist_tpu.rpc.server",
+             "--port", str(w["port"]),
+             "--task_index", str(w.get("task_index", 0))],
+            env=env))
+        print(f"launched worker task_index={w.get('task_index')} "
+              f"port={w['port']} pid={procs[-1].pid}")
+    for p in procs:
+        p.wait()
+
+
+if __name__ == "__main__":
+    main()

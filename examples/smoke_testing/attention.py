@@ -1,0 +1,36 @@
+"""Smoke test: a single attention block through the auto-parallel planner
+(reference: examples/smoke_testing/attention.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "..", "..")))
+
+import jax
+import jax.numpy as jnp
+
+from tepdist_tpu.core.mesh import MeshTopology
+from tepdist_tpu.models import mlp
+from tepdist_tpu.parallel.auto_parallel import auto_parallel
+
+
+def main():
+    k = jax.random.PRNGKey(0)
+    params = mlp.init_attention(k, d=64, heads=4)
+    x = jax.random.normal(k, (8, 32, 64))
+    y = jnp.zeros_like(x)
+    n = len(jax.devices())
+    topo = MeshTopology([("data", n)])
+    plan = auto_parallel(jax.value_and_grad(mlp.attention_loss), topo,
+                         params, x, y)
+    for i in range(5):
+        loss, grads = plan.step(params, x, y)
+        params = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g
+                                        if g is not None else p,
+                                        params, grads)
+        print(f"step {i}: loss = {float(loss):.6f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Smoke test: conv net through the auto-parallel planner
+(reference: examples/smoke_testing/conv.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "..", "..")))
+
+import jax
+import jax.numpy as jnp
+
+from tepdist_tpu.core.mesh import MeshTopology
+from tepdist_tpu.models import mlp
+from tepdist_tpu.parallel.auto_parallel import auto_parallel
+
+
+def main():
+    k = jax.random.PRNGKey(0)
+    params = mlp.init_conv(k)
+    x = jax.random.normal(k, (32, 16, 16, 3))
+    y = jnp.zeros((32,), jnp.int32)
+    n = len(jax.devices())
+    plan = auto_parallel(jax.value_and_grad(mlp.conv_loss),
+                         MeshTopology([("data", n)]), params, x, y)
+    for i in range(5):
+        loss, grads = plan.step(params, x, y)
+        params = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g,
+                                        params, grads)
+        print(f"step {i}: loss = {float(loss):.6f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+#!/bin/bash
+# Reference parity: run_*.sh — client is CPU-only, server owns devices.
+set -e
+cd "$(dirname "$0")/../.."
+python examples/smoke_testing/simple.py --local --steps 10
+python examples/smoke_testing/attention.py
+python examples/smoke_testing/conv.py

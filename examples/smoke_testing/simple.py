@@ -1,0 +1,81 @@
+"""Smoke test: 1-hidden-layer MLP trained through the client/server path.
+
+Reference parity: examples/smoke_testing/simple.py (loss printed per step;
+client runs without accelerators — the server owns the devices). Set
+SERVER_IP/SERVER_PORT to use a running server, or run with --local to spawn
+one on this machine.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "..", "..")))
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def spawn_local_server() -> tuple:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tepdist_tpu.rpc.server", "--port", str(port)],
+        env=env)
+    return proc, port
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--local", action="store_true",
+                        help="spawn a local server")
+    parser.add_argument("--steps", type=int, default=10)
+    args = parser.parse_args()
+
+    proc = None
+    address = None
+    if args.local:
+        proc, port = spawn_local_server()
+        address = f"127.0.0.1:{port}"
+
+    from tepdist_tpu.client.session import TepdistSession
+    from tepdist_tpu.models import mlp
+
+    k = jax.random.PRNGKey(0)
+    params = mlp.init_mlp(k, din=32, dh=64, dout=8)
+    x = jax.random.normal(k, (256, 32))
+    y = jnp.ones((256, 8))
+    tx = optax.sgd(0.1)
+
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(mlp.mlp_loss)(params, x, y)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), opt_state
+
+    sess = TepdistSession(address)
+    sess.client.wait_ready()
+    info = sess.client.ping()
+    print(f"server: {info['n_devices']} {info['platform']} devices")
+    summary = sess.compile_train_step(step, params, tx.init(params), x, y)
+    print(f"plan: {summary}")
+    for i in range(args.steps):
+        loss = sess.run(x, y)
+        print(f"step {i}: loss = {loss:.6f}")
+    sess.close()
+    if proc is not None:
+        proc.send_signal(signal.SIGKILL)
+
+
+if __name__ == "__main__":
+    main()
