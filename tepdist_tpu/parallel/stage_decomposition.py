@@ -157,6 +157,26 @@ class StageDecomposition:
     def forward_fns(self) -> List[Callable]:
         return [self.stage_fn(s) for s in range(self.num_stages)]
 
+    def stage_closed_jaxpr(self, s: int):
+        """Package stage ``s`` as a standalone ClosedJaxpr (the wire form of
+        a def-module for TransferModuleAndDefCtx)."""
+        from jax._src import core as _core
+        from jax.extend import core as jexcore
+
+        m = self.stages[s]
+        used_consts = []
+        seen = set()
+        for eqn in m.eqns:
+            for a in eqn.invars:
+                if (isinstance(a, Var) and a in self._const_env
+                        and id(a) not in seen):
+                    seen.add(id(a))
+                    used_consts.append(a)
+        jaxpr = _core.Jaxpr(constvars=used_consts, invars=list(m.invars),
+                            outvars=list(m.outvars), eqns=list(m.eqns))
+        consts = [self._const_env[v] for v in used_consts]
+        return jexcore.ClosedJaxpr(jaxpr, consts)
+
     def cross_stage_bytes(self) -> float:
         """Activation traffic of the cut (reference CollectCrossStageInsts)."""
         from tepdist_tpu.graph.cost import aval_bytes

@@ -94,6 +94,11 @@ class TepdistServicer:
         self.ckpt_dir = os.environ.get("TEPDIST_CKPT_DIR",
                                        "/tmp/tepdist_ckpt")
         self._lock = threading.Lock()
+        # Slave-side distributed plan state (reference lifecycle §3.5).
+        from tepdist_tpu.rpc.worker_plan import RawStore
+        self.raw_store = RawStore()
+        self.stage_modules: Dict[int, Any] = {}
+        self.worker_plan = None
 
     # ------------------------------------------------------------------
     def BuildExecutionPlan(self, request: bytes, context=None) -> bytes:
@@ -165,6 +170,13 @@ class TepdistServicer:
         return protocol.pack({"ok": True, "global_idx": idx})
 
     def TransferHostRawData(self, request: bytes, context=None) -> bytes:
+        """Raw-keyed per-step data (reference: per-step input slices +
+        peer-to-peer activation pushes in the RPC transport)."""
+        header, blobs = protocol.unpack(request)
+        if "raw_key" in header:
+            arr = protocol.decode_literal(header["literal"], blobs[0])
+            self.raw_store.put(header["raw_key"], arr)
+            return protocol.pack({"ok": True})
         return self.TransferToServerHost(request, context)
 
     def TransferVarArgMap(self, request: bytes, context=None) -> bytes:
@@ -250,19 +262,37 @@ class TepdistServicer:
 
     # ------------------------------------------------------------------
     def TransferModuleAndDefCtx(self, request: bytes, context=None) -> bytes:
+        """Receive a (stage) def-module + its DefContext-style metadata and
+        build the jitted runtime for it (reference: create_def_ctx_from_proto
+        + module rebuild, service_rt.cc:467)."""
         header, blobs = protocol.unpack(request)
-        self.modules[int(header.get("module_id", 0))] = blobs[0]
+        module_id = int(header.get("module_id", 0))
+        self.modules[module_id] = blobs[0]
+        meta = header.get("stage_meta")
+        if meta is not None:
+            from tepdist_tpu.rpc.worker_plan import StageModuleRuntime
+            closed = deserialize_closed_jaxpr(blobs[0])
+            self.stage_modules[module_id] = StageModuleRuntime(closed, meta)
         return protocol.pack({"ok": True})
 
     def DispatchPlan(self, request: bytes, context=None) -> bytes:
+        """Receive this worker's task list + plan metadata and build the
+        executable WorkerPlan (reference: BuildDistributedPlanRPC,
+        virtual_client.cc:776)."""
         header, _ = protocol.unpack(request)
-        # Slave-side plan rebuild (multi-host round 2 target): store tasks.
-        self._dispatched_tasks = header.get("tasks", [])
-        return protocol.pack({"ok": True, "n_tasks":
-                              len(self._dispatched_tasks)})
+        tasks = header.get("tasks", [])
+        self._dispatched_tasks = tasks
+        if header.get("plan_meta"):
+            from tepdist_tpu.rpc.worker_plan import WorkerPlan
+            self.worker_plan = WorkerPlan(self, tasks, header["plan_meta"])
+        return protocol.pack({"ok": True, "n_tasks": len(tasks)})
 
     def ExecuteRemotePlan(self, request: bytes, context=None) -> bytes:
-        return protocol.pack({"ok": True})
+        header, _ = protocol.unpack(request)
+        if self.worker_plan is None:
+            return protocol.pack({"ok": True, "losses": []})
+        result = self.worker_plan.run_step(int(header.get("step", 0)))
+        return protocol.pack({"ok": True, **result})
 
     def InitMeshTopology(self, request: bytes, context=None) -> bytes:
         header, _ = protocol.unpack(request)

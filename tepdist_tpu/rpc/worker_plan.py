@@ -1,0 +1,251 @@
+"""Worker-side distributed plan execution.
+
+Reference parity: the slave lifecycle (reference: service_rt.cc:310-528 +
+DAPPLEExecutable::ExecuteRemotePlan, virtual_client.cc:2314): a worker
+receives the def-modules (TransferModuleAndDefCtx), its slice of the task
+DAG (DispatchPlan), per-step raw inputs (TransferHostRawData), and executes
+its per-device task list on ExecuteRemotePlan — receiving activations from
+peers and sending its own onward.
+
+TPU deltas: NCCL p2p Send/Recv between workers becomes an RPC raw-data push
+to the consumer's host store (the DCN path); within a worker, stage
+computations run jitted on the worker's own devices. A blocking store with a
+condition variable replaces CUDA-event barriers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class RawStore:
+    """Keyed host store with blocking get (the kRecv wait)."""
+
+    def __init__(self):
+        self._data: Dict[str, Any] = {}
+        self._cv = threading.Condition()
+
+    def put(self, key: str, value: Any) -> None:
+        with self._cv:
+            self._data[key] = value
+            self._cv.notify_all()
+
+    def get(self, key: str, timeout: float = 60.0) -> Any:
+        """Non-destructive blocking read: the forward AND its remat backward
+        both re-read stage inputs, so values live until the step's cleanup."""
+        deadline = time.time() + timeout
+        with self._cv:
+            while key not in self._data:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(f"raw data {key!r} never arrived")
+                self._cv.wait(remaining)
+            return self._data[key]
+
+    def clear_step(self, step: int) -> None:
+        suffix = f":{step}"
+        prefix = f"batch:{step}:"
+        with self._cv:
+            for k in [k for k in self._data
+                      if k.endswith(suffix) or k.startswith(prefix)]:
+                del self._data[k]
+
+    def clear(self) -> None:
+        with self._cv:
+            self._data.clear()
+
+
+class StageModuleRuntime:
+    """One received stage module: jitted forward + VJP backward."""
+
+    def __init__(self, closed_jaxpr, meta: Dict[str, Any]):
+        from jax.extend.core import jaxpr_as_fun
+
+        self.meta = meta
+        fwd = jaxpr_as_fun(closed_jaxpr)
+        self._fwd = jax.jit(fwd)
+        n_in = len(closed_jaxpr.jaxpr.invars)
+        out_avals = [v.aval for v in closed_jaxpr.jaxpr.outvars]
+        wired = tuple(meta.get("wired_cots", []))
+        loss_out = meta.get("loss_out")
+
+        def bwd(*args):
+            ins = args[:n_in]
+            cots_in = args[n_in:]
+            cots = []
+            it = iter(cots_in)
+            for k, av in enumerate(out_avals):
+                if k in wired:
+                    cots.append(next(it))
+                elif k == loss_out:
+                    cots.append(jnp.ones(av.shape, av.dtype))
+                else:
+                    cots.append(jnp.zeros(av.shape, av.dtype))
+            _, vjp_fn = jax.vjp(fwd, *ins)
+            return vjp_fn(list(cots))  # jaxpr_as_fun returns a list
+
+        self._bwd = jax.jit(bwd)
+
+    def forward(self, *args):
+        return self._fwd(*args)
+
+    def backward(self, *args):
+        return self._bwd(*args)
+
+
+class WorkerPlan:
+    """A dispatched per-worker task list, executable step by step."""
+
+    def __init__(self, servicer, tasks: List[dict], plan_meta: Dict[str, Any]):
+        self.servicer = servicer
+        self.tasks = tasks
+        self.meta = plan_meta
+        self.task_index = plan_meta["task_index"]
+        self.num_micro = plan_meta["num_micro_batches"]
+        self.raw = servicer.raw_store
+        self._peers: Dict[int, Any] = {}
+        # stage id -> StageModuleRuntime (from servicer.stage_modules)
+        self.stages = servicer.stage_modules
+        # consumer task id -> (worker, key) routing for sends
+        self.send_routes = {int(k): v for k, v in
+                            plan_meta.get("send_routes", {}).items()}
+
+    def _peer(self, task_index: int):
+        from tepdist_tpu.rpc.client import TepdistClient
+
+        if task_index not in self._peers:
+            workers = self.meta["cluster"]["workers"]
+            w = next(w for w in workers if w["task_index"] == task_index)
+            self._peers[task_index] = TepdistClient(
+                f"{w['ip']}:{w['port']}")
+        return self._peers[task_index]
+
+    # ------------------------------------------------------------------
+    def run_step(self, step: int) -> Dict[str, float]:
+        outputs: Dict[int, Tuple] = {}
+        losses: List[float] = []
+        ga_acc: Dict[int, Tuple] = {}
+
+        def stage_args(task) -> List[Any]:
+            s = task["stage"]
+            meta = self.stages[s].meta
+            args = []
+            for pos in range(meta["n_invars"]):
+                src = meta["input_def_map"][str(pos)]
+                if src[0] == "arg":
+                    gi = src[1]
+                    if gi in meta["batch_indices"]:
+                        args.append(self.raw.get(
+                            f"batch:{step}:{task['micro']}:{gi}"))
+                    else:
+                        args.append(self.servicer.variables[gi])
+                else:
+                    # activation: produced by a recv or local task; wiring
+                    # in input_specs maps arg positions to parent tasks.
+                    pid, oi = task["input_specs"][str(pos)]
+                    args.append(outputs[pid][oi])
+            return args
+
+        for task in self.tasks:
+            tt = task["type"]
+            tid = task["node_id"]
+            s = task["stage"]
+            if tt == "compute" and task["name"].startswith("fwd"):
+                outs = self.stages[s].forward(*stage_args(task))
+                outputs[tid] = outs
+                loss_out = self.stages[s].meta.get("loss_out")
+                if loss_out is not None and loss_out >= 0:
+                    losses.append(float(jax.device_get(outs[loss_out])))
+            elif tt == "compute" and task["name"].startswith("bwd"):
+                meta = self.stages[s].meta
+                args = stage_args(task)
+                cot_args = [outputs[pid][oi] for pos, (pid, oi) in
+                            sorted(((int(p), v) for p, v in
+                                    task["input_specs"].items()))
+                            if pos >= meta["n_invars"]]
+                outputs[tid] = self.stages[s].backward(*args, *cot_args)
+            elif tt == "send":
+                pid, oi = task["input_specs"]["0"]
+                val = outputs[pid][oi]
+                route = self.send_routes.get(tid)
+                outputs[tid] = (val,)
+                if route is not None:
+                    peer_worker, key = route
+                    key = f"{key}:{step}"
+                    if peer_worker == self.task_index:
+                        self.raw.put(key, val)
+                    else:
+                        from tepdist_tpu.rpc import protocol
+
+                        meta_l, blob = protocol.encode_literal(
+                            np.asarray(jax.device_get(val)))
+                        self._peer(peer_worker).stub.call(
+                            "TransferHostRawData", protocol.pack(
+                                {"raw_key": key, "literal": meta_l}, [blob]))
+            elif tt == "recv":
+                parent = task["input_specs"].get("0")
+                if parent is not None and parent[0] in outputs:
+                    # producer ran on this worker: local passthrough
+                    outputs[tid] = (outputs[parent[0]][parent[1]],)
+                else:
+                    key = self.meta["recv_keys"][str(tid)] + f":{step}"
+                    outputs[tid] = (self.raw.get(key),)
+            elif tt == "ga_init":
+                meta = self.stages[s].meta
+                outputs[tid] = (tuple(
+                    jnp.zeros(tuple(sh), dt)
+                    for sh, dt in meta["param_avals"]),)
+            elif tt == "ga":
+                acc = outputs[task["input_specs"]["0"][0]][
+                    task["input_specs"]["0"][1]]
+                bwd_outs = outputs[task["input_specs"]["1"][0]]
+                ppos = self.stages[s].meta["param_positions"]
+                outputs[tid] = (tuple(a + bwd_outs[p]
+                                      for a, p in zip(acc, ppos)),)
+            elif tt == "apply":
+                acc = outputs[task["input_specs"]["0"][0]][
+                    task["input_specs"]["0"][1]]
+                # Shared-parameter contributions from other stages arrive at
+                # arg positions >= 1 (stage id + 1), mirroring the local
+                # executor's _apply_stage.
+                extras = {}
+                for pos_s, spec in task["input_specs"].items():
+                    if int(pos_s) >= 1:
+                        extras[int(pos_s) - 1] = outputs[spec[0]][spec[1]]
+                self._apply(s, acc, extras)
+                outputs[tid] = ()
+            else:
+                outputs[tid] = ()
+            # GC: release buffers whose last (scheduled) consumer just ran.
+            for rid in task.get("mem_to_release", []):
+                outputs.pop(rid, None)
+        self.raw.clear_step(step)
+        return {"losses": losses}
+
+    def _apply(self, s: int, acc, extras=None) -> None:
+        """Apply gradients for params OWNED by stage ``s`` only, summing
+        shared params' contributions from other stages' accumulators."""
+        meta = self.stages[s].meta
+        M = self.num_micro
+        lr = self.meta.get("learning_rate", 0.01)
+        owned = set(meta.get("owned_global_idx", meta["param_global_idx"]))
+        grads = {gi: jnp.asarray(g)
+                 for gi, g in zip(meta["param_global_idx"], acc)
+                 if gi in owned}
+        for t, eacc in (extras or {}).items():
+            t_meta = self.stages[t].meta if t in self.stages else None
+            if t_meta is None:
+                continue
+            for gi, g in zip(t_meta["param_global_idx"], eacc):
+                if gi in grads:
+                    grads[gi] = grads[gi] + jnp.asarray(g)
+        for gi, g in grads.items():
+            p = self.servicer.variables[gi]
+            self.servicer.variables[gi] = p - lr * (g / M)

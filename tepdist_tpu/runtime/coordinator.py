@@ -35,6 +35,7 @@ def serialize_task(node) -> dict:
         "port_map": {str(k): v for k, v in node.port_map.items()},
         "parents": list(node.parents),
         "children": list(node.children),
+        "mem_to_release": list(node.mem_to_release),
     }
 
 
