@@ -118,6 +118,63 @@ def plan_axes(
     return strategies
 
 
+def apply_mem_save(
+    graph: JaxprGraph,
+    strategies: List[GraphStrategy],
+    topology: MeshTopology,
+    var_mem_limit: int,
+    state_invars: Optional[Sequence[int]] = None,
+) -> List[int]:
+    """ZeRO-style variable splitting for memory (reference:
+    ``SplitPlanByMemCost``/``MemSavePlan``, cost_spmd_strategy.h:900-911 +
+    the ``VAR_MEM_LIMIT`` env): while per-device variable bytes exceed the
+    limit, force-shard the largest still-replicated state variable's storage
+    along the biggest mesh axis (largest divisible dim). GSPMD inserts the
+    gathers where compute needs the full value. Returns the invar indices
+    that were split."""
+    from tepdist_tpu.graph.cost import aval_bytes
+
+    if not strategies:
+        return []
+    # Shard over the largest device axis (usually 'data' — ZeRO semantics).
+    gs = max(strategies, key=lambda g: g.num_splits)
+    n = gs.num_splits
+    candidates = (list(state_invars) if state_invars is not None
+                  else range(len(graph.invars)))
+
+    def per_device_bytes() -> float:
+        total = 0.0
+        for i in candidates:
+            v = graph.invars[i]
+            b = aval_bytes(v.aval)
+            for g in strategies:
+                s = g.var_strategies.get(v)
+                if s is not None and s.is_split():
+                    b /= s.num_splits
+            total += b
+        return total
+
+    split: List[int] = []
+    order = sorted(
+        candidates,
+        key=lambda i: -aval_bytes(graph.invars[i].aval))
+    for i in order:
+        if per_device_bytes() <= var_mem_limit:
+            break
+        v = graph.invars[i]
+        cur = gs.var_strategies.get(v)
+        if cur is not None and cur.is_split():
+            continue
+        shape = v.aval.shape
+        dims = sorted(range(len(shape)), key=lambda d: -shape[d])
+        for d in dims:
+            if shape[d] % n == 0 and shape[d] >= n:
+                gs.var_strategies[v] = DimStrategy.split_on(d, n)
+                split.append(i)
+                break
+    return split
+
+
 def auto_parallel(
     fn: Callable,
     topology: MeshTopology,
@@ -125,12 +182,15 @@ def auto_parallel(
     annotations: Optional[Dict[int, Dict[str, DimStrategy]]] = None,
     mode: Optional[str] = None,
     state_alias: Optional[Dict[int, int]] = None,
+    var_mem_limit: Optional[int] = None,
     **example_kwargs,
 ) -> ParallelPlan:
     """Plan ``fn`` over ``topology``. Modes: "cost" (default), "rule".
 
     ``state_alias``: outvar flat index -> invar flat index for training-state
-    threading (forces matching shardings across steps)."""
+    threading (forces matching shardings across steps). ``var_mem_limit``
+    (or the VAR_MEM_LIMIT env): per-device variable-byte budget triggering
+    ZeRO-style storage splitting."""
     env = ServiceEnv.get()
     if mode is None:
         mode = "rule" if env.rule_mode else "cost"
@@ -138,6 +198,25 @@ def auto_parallel(
         annotations = None
     graph, in_tree, out_tree = trace_graph(fn, *example_args, **example_kwargs)
     strategies = plan_axes(graph, topology, annotations, mode)
+    state_invars = sorted({ii for ii in (state_alias or {}).values()
+                           if ii >= 0})
+    if var_mem_limit is None and env.var_mem_limit > 0:
+        var_mem_limit = env.var_mem_limit
+    if var_mem_limit is not None and var_mem_limit > 0:
+        apply_mem_save(graph, strategies, topology, var_mem_limit,
+                       state_invars or None)
+    # Param <-> optimizer-slot affinity: slots adopt their param's sharding
+    # (reference AUX_AFFINITY) so the apply step never reshards.
+    if state_alias and env.aux_affinity:
+        from tepdist_tpu.parallel.inst_affinity import (
+            build_affinity_groups,
+            unify_group_strategies,
+        )
+        try:
+            groups = build_affinity_groups(graph, state_alias)
+            unify_group_strategies(graph, strategies, groups)
+        except Exception as e:  # noqa: BLE001 — affinity is an optimization
+            log.warning("affinity unification skipped: %s", e)
     xform = SpmdTransform(graph, topology)
     sharding_plan = xform.lower(strategies, state_alias=state_alias)
     return ParallelPlan(

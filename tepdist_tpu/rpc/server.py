@@ -187,6 +187,7 @@ class TepdistServicer:
 
     # ------------------------------------------------------------------
     def ExecutePlan(self, request: bytes, context=None) -> bytes:
+        t_exec0 = time.time()
         header, blobs = protocol.unpack(request)
         handle = int(header["handle"])
         plan = self.plan_cache.resolve(handle)
@@ -239,6 +240,9 @@ class TepdistServicer:
                         fetched[str(ii)] = {"meta": meta,
                                             "blob": len(out_blobs)}
                         out_blobs.append(blob)
+        if ServiceEnv.get().debug:
+            log.info("[ExecutePlan Duration] step=%d %.1f ms",
+                     self.global_step, (time.time() - t_exec0) * 1e3)
         return protocol.pack(
             {"outputs": metas, "output_indices": out_idx,
              "fetched": fetched, "global_step": self.global_step},

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from tepdist_tpu.core.service_env import ServiceEnv
 from tepdist_tpu.parallel.pipeline import PipelineProgram
 from tepdist_tpu.runtime.execution_plan import (
     PipelinePlanMaps,
@@ -220,7 +221,15 @@ class PipelineExecutable:
 
     # ------------------------------------------------------------------
     def step(self, *batch) -> Any:
-        """Run one scheduled training step; returns the mean loss."""
+        """Run one scheduled training step; returns the mean loss.
+
+        With DEBUG on, per-task wall-clock is logged with task/stage/micro
+        ids (reference: DEBUG-gated NowMicros timing around every task,
+        virtual_client.cc:1672-1803)."""
+        import time as _time
+
+        debug = ServiceEnv.get().debug
+        t_step0 = _time.perf_counter()
         prog = self.prog
         S = prog.num_stages
         M = prog.num_micro_batches
@@ -264,6 +273,7 @@ class PipelineExecutable:
         for tid in self.schedule.order:
             node = self.dag.node(tid)
             tt = node.task_type
+            t_task0 = _time.perf_counter() if debug else 0.0
             s, m = node.stage, node.micro
             if tt in (TaskType.SPLIT, TaskType.INPUT, TaskType.MERGE):
                 outputs[tid] = ()
@@ -308,12 +318,19 @@ class PipelineExecutable:
                 outputs[tid] = ()
             else:
                 outputs[tid] = ()
+            if debug:
+                log.info("[task] %s stage=%d micro=%d %.3f ms",
+                         node.key(), node.stage, node.micro,
+                         (_time.perf_counter() - t_task0) * 1e3)
             # GC: free buffers whose last consumer just ran.
             for rid in node.mem_to_release:
                 outputs.pop(rid, None)
 
         self.global_step += 1
         loss = sum(jax.device_get(l) for l in losses) / M
+        if debug:
+            log.info("[ExecutePlan Duration] step=%d %.3f ms",
+                     self.global_step, (_time.perf_counter() - t_step0) * 1e3)
         return loss
 
     def _apply_stage(self, s: int, acc: Tuple, M: int,

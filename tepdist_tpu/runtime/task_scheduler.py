@@ -40,6 +40,31 @@ class ScheduleResult:
                 out.extend(tasks)
         return sorted(out, key=lambda t: self.start[t])
 
+    def to_chrome_trace(self, dag, path: str) -> None:
+        """Export the simulated schedule as a Chrome trace (chrome://tracing
+        / Perfetto). The reference only had dot dumps + per-task logs
+        (SURVEY §5.1); a timeline view is TPU-build surplus."""
+        import json
+
+        events = []
+        for tid in self.order:
+            n = dag.node(tid)
+            for d in (n.device_group or (0,)):
+                events.append({
+                    "name": n.name,
+                    "cat": n.task_type.value,
+                    "ph": "X",
+                    "ts": self.start[tid] * 1e6,
+                    "dur": max((self.finish[tid] - self.start[tid]) * 1e6,
+                               0.01),
+                    "pid": 0,
+                    "tid": d,
+                    "args": {"stage": n.stage, "micro": n.micro},
+                })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+
 
 class TaskScheduler:
     """List scheduler over a TaskDAG with simulated time + memory."""
