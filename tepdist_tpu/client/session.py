@@ -111,6 +111,51 @@ class TepdistSession:
         return float(np.asarray(loss))
 
     # ------------------------------------------------------------------
+    def run_async(self, *batch):
+        """Pipelined step submission (reference: the optional async RPC path
+        bounded by a semaphore — num_parallel_rpc_steps, xla_ops.h:229-232).
+
+        The batch is ENCODED on the caller's thread immediately (that is the
+        client-side work overlappable with execution — inline literals ride
+        with ExecutePlan, there is no separate transfer RPC); the RPC itself
+        is dispatched from a single-worker queue, so step order is preserved
+        while step N+1's encoding overlaps step N's server execution. At
+        most 2 steps are in flight; the permit is released by the future's
+        done callback (which also fires on cancellation, so cancelled
+        futures cannot leak permits)."""
+        import concurrent.futures
+        import threading
+
+        assert self.handle is not None, "compile_train_step first"
+        if not hasattr(self, "_pool"):
+            self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+            self._inflight = threading.Semaphore(2)
+
+        # Encode now, on the caller thread.
+        leaves = jax.tree_util.tree_leaves(batch)
+        inline = {idx: np.asarray(v)
+                  for idx, v in zip(self._batch_leaf_idx, leaves)}
+        fetch = (self.fetch_every > 0 and
+                 (self._step_count + 1) % self.fetch_every == 0)
+        self._step_count += 1
+
+        self._inflight.acquire()
+
+        def go():
+            result = self.client.execute_plan(
+                self.handle, inline_args=inline,
+                fetch_resource_variables=fetch)
+            return float(np.asarray(result["outputs"][0]))
+
+        try:
+            future = self._pool.submit(go)
+        except Exception:
+            self._inflight.release()
+            raise
+        future.add_done_callback(lambda _f: self._inflight.release())
+        return future
+
+    # ------------------------------------------------------------------
     def variables(self):
         """Fetch (params, opt_state) back from the server
         (reference FetchResourceVars)."""
@@ -130,4 +175,8 @@ class TepdistSession:
         self.client.do_remote_restore(global_step=global_step)
 
     def close(self) -> None:
+        # Drain queued async steps before the channel goes away.
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=True)
         self.client.close()

@@ -178,6 +178,14 @@ def analyze_sync_free(
     candidate_args: Optional[List[int]] = None,
     hbm_budget_bytes: Optional[float] = None,
 ) -> SyncFreeResult:
+    # Liveness pre-pass (reference: HloLivenessOptimizer runs before the
+    # planner): the peak estimate below sees shortened live ranges for
+    # cheap duplicable producers, as XLA's remat will at compile time.
+    try:
+        from tepdist_tpu.parallel.liveness import optimize_liveness
+        graph = optimize_liveness(graph)
+    except Exception:  # noqa: BLE001 — estimation aid only
+        pass
     found = find_sync_free_split(graph, candidate_args)
     if found is None:
         return SyncFreeResult([], {}, 0.0, 1, estimate_peak_activation_bytes(graph))

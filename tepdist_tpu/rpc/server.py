@@ -94,6 +94,10 @@ class TepdistServicer:
         self.ckpt_dir = os.environ.get("TEPDIST_CKPT_DIR",
                                        "/tmp/tepdist_ckpt")
         self._lock = threading.Lock()
+        # Serialize plan execution: pipelined client submissions must run in
+        # arrival order against a consistent variable store (reference:
+        # execute_plan_mutex_, service_rt.cc:619).
+        self._exec_lock = threading.Lock()
         # Slave-side distributed plan state (reference lifecycle §3.5).
         from tepdist_tpu.rpc.worker_plan import RawStore
         self.raw_store = RawStore()
@@ -212,12 +216,13 @@ class TepdistServicer:
                     args.append(self.inputs[i])
                 else:
                     raise KeyError(f"arg {i} neither transferred nor inline")
-        outs = plan.step_fn(*args)
-        # Write aliased state back into the variable store (server-held).
-        with self._lock:
-            for oi, ii in plan.state_alias.items():
-                self.variables[ii] = outs[oi]
-        self.global_step += 1
+        with self._exec_lock:
+            outs = plan.step_fn(*args)
+            # Write aliased state back into the variable store (server-held).
+            with self._lock:
+                for oi, ii in plan.state_alias.items():
+                    self.variables[ii] = outs[oi]
+            self.global_step += 1
         # Latched save?
         if self.ckpt_opts.get("save"):
             self._do_save(self.ckpt_opts.pop("save"))

@@ -158,3 +158,22 @@ def test_gpt2_remote_training(server):
     losses = [sess.run(tokens) for _ in range(4)]
     assert losses[-1] < losses[0]
     sess.close()
+
+
+def test_async_pipelined_steps(server):
+    port, _ = server
+    loss_fn, step, params, opt_state, x, y = _mlp_setup(batch=32)
+    # Sequential reference in its own session (fresh server-side state).
+    ref = TepdistSession(f"127.0.0.1:{port}", mesh_axes=[("data", 4)])
+    ref.compile_train_step(step, params, opt_state, x, y)
+    seq_losses = [ref.run(x, y) for _ in range(4)]
+    ref.close()
+
+    sess = TepdistSession(f"127.0.0.1:{port}", mesh_axes=[("data", 4)])
+    sess.compile_train_step(step, params, opt_state, x, y)
+    futures = [sess.run_async(x, y) for _ in range(4)]
+    losses = [f.result(timeout=120) for f in futures]
+    # Pipelined submission must produce exactly the sequential trajectory
+    # (order preserved, no dropped/duplicated steps).
+    np.testing.assert_allclose(losses, seq_losses, rtol=1e-6)
+    sess.close()

@@ -1,0 +1,61 @@
+"""Standalone module runner (debug tool).
+
+Reference parity: ``run_arbitary_hlo.cc`` (reference: rpc/run_arbitary_hlo.cc)
+— a binary that executes a module outside the service for debugging. This
+version runs a serialized jaxpr module (the wire format of
+BuildExecutionPlan) with zero/random inputs and prints output summaries.
+
+    python tools/run_jaxpr.py module.bin [--random] [--platform cpu]
+"""
+
+import argparse
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "..")))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("module", help="serialized jaxpr module file")
+    parser.add_argument("--random", action="store_true")
+    parser.add_argument("--platform", default="")
+    parser.add_argument("--dump", action="store_true",
+                        help="print the deserialized jaxpr")
+    args = parser.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from jax.extend.core import jaxpr_as_fun
+
+    from tepdist_tpu.rpc.jaxpr_serde import deserialize_closed_jaxpr
+
+    with open(args.module, "rb") as f:
+        closed = deserialize_closed_jaxpr(f.read())
+    if args.dump:
+        print(closed.jaxpr)
+    key = jax.random.PRNGKey(0)
+    inputs = []
+    for i, v in enumerate(closed.jaxpr.invars):
+        aval = v.aval
+        if args.random and np.issubdtype(aval.dtype, np.floating):
+            key, sub = jax.random.split(key)
+            inputs.append(jax.random.normal(sub, aval.shape, aval.dtype))
+        else:
+            inputs.append(jnp.zeros(aval.shape, aval.dtype))
+    outs = jax.jit(jaxpr_as_fun(closed))(*inputs)
+    for i, o in enumerate(outs):
+        arr = np.asarray(jax.device_get(o))
+        print(f"out[{i}]: shape={arr.shape} dtype={arr.dtype} "
+              f"mean={arr.mean() if arr.size else float('nan'):.6g} "
+              f"finite={bool(np.isfinite(arr).all())}")
+
+
+if __name__ == "__main__":
+    main()
