@@ -34,7 +34,7 @@ def main() -> None:
     on_tpu = devices[0].platform != "cpu"
     if on_tpu:
         cfg = gpt2.CONFIGS["117M"]
-        batch, seq, steps = 8, 512, 30
+        batch, seq, steps = 16, 512, 20
         model_name = "gpt2_117m"
     else:  # CPU fallback keeps the harness runnable anywhere
         cfg = gpt2.CONFIGS["test"]
@@ -86,14 +86,19 @@ def main() -> None:
     _ = float(jax.device_get(outs[0]))
     flat = thread_state(flat, outs)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        outs = step(*flat)
-        flat = thread_state(flat, outs)
-    # block_until_ready is not a reliable barrier through the remote PJRT
-    # tunnel; fetching the loss to host is.
-    _ = float(jax.device_get(outs[0]))
-    dt = time.perf_counter() - t0
+    # Best of 3 timed windows (variance through the remote tunnel is real;
+    # block_until_ready is not a reliable barrier there — a host round-trip
+    # of the loss is).
+    best_dt = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            outs = step(*flat)
+            flat = thread_state(flat, outs)
+        _ = float(jax.device_get(outs[0]))
+        dt = time.perf_counter() - t0
+        best_dt = dt if best_dt is None else min(best_dt, dt)
+    dt = best_dt
 
     tokens_per_sec = batch * seq * steps / dt
     tokens_per_sec_per_chip = tokens_per_sec / n_dev
