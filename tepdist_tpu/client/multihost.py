@@ -1,0 +1,116 @@
+"""Multi-host SPMD session: one logical step over N multi-controller servers.
+
+The TPU-native multi-host execution model (SURVEY §5.8): servers started
+with ``--coordinator_address`` form one jax.distributed fleet whose devices
+compose a single global mesh; XLA compiles the SAME program on every process
+and runs collectives over ICI/DCN. The control plane stays gRPC: this
+session BROADCASTS every plan/execute/fetch RPC to all workers so each
+process enters the same computation in the same order (the multi-controller
+contract) — the reference's master/slave dispatch, with the NCCL rendezvous
+replaced by the PJRT coordination service.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+from tepdist_tpu.rpc import protocol
+from tepdist_tpu.rpc.client import TepdistClient
+from tepdist_tpu.rpc.jaxpr_serde import serialize_closed_jaxpr
+
+
+class MultiHostSession:
+    def __init__(self, addresses: Sequence[str], mesh_axes: Sequence = (),
+                 mode: str = "cost"):
+        self.clients = [TepdistClient(a) for a in addresses]
+        self.mesh_axes = list(mesh_axes)
+        self.mode = mode
+        self.handle: Optional[int] = None
+        self._step_count = 0
+
+    def _broadcast(self, fn, *args, **kwargs) -> List[Any]:
+        """Run an RPC on every worker concurrently; all must succeed.
+        Collectives inside the RPC (execution, gathers) synchronize the
+        processes, so a missing participant would hang — surface errors."""
+        results: List[Any] = [None] * len(self.clients)
+        errors: Dict[int, Exception] = {}
+
+        def run(i, c):
+            try:
+                results[i] = fn(c, *args, **kwargs)
+            except Exception as e:  # noqa: BLE001
+                errors[i] = e
+
+        threads = [threading.Thread(target=run, args=(i, c))
+                   for i, c in enumerate(self.clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(f"multi-host broadcast failures: {errors}")
+        return results
+
+    # ------------------------------------------------------------------
+    def wait_ready(self, timeout: float = 60.0) -> List[Dict]:
+        self._broadcast(lambda c: c.wait_ready(timeout))
+        return self._broadcast(lambda c: c.ping())
+
+    def compile_train_step(self, step_fn, params, opt_state, *example_batch):
+        closed = jax.make_jaxpr(step_fn)(params, opt_state, *example_batch)
+        module = serialize_closed_jaxpr(closed)
+        state_leaves = jax.tree_util.tree_leaves((params, opt_state))
+        self._state_tree = jax.tree_util.tree_structure((params, opt_state))
+        self._n_state = len(state_leaves)
+        n_batch = len(jax.tree_util.tree_leaves(example_batch))
+        self._batch_leaf_idx = list(range(self._n_state,
+                                          self._n_state + n_batch))
+        state_alias = {1 + k: k for k in range(self._n_state)}
+
+        def build(c):
+            return c.build_execution_plan(
+                module, mesh_axes=self.mesh_axes,
+                variable_indices=list(range(self._n_state)),
+                state_alias=state_alias, mode=self.mode)
+
+        resps = self._broadcast(build)
+        handles = {r["handle"] for r in resps}
+        assert len(handles) == 1, f"divergent plan handles: {handles}"
+        self.handle = handles.pop()
+
+        # Broadcast variables: each process will place its local shards.
+        for i, leaf in enumerate(state_leaves):
+            arr = np.asarray(leaf)
+            self._broadcast(
+                lambda c, a=arr, gi=i: c.transfer_to_server_host(
+                    a, gi, variable=True))
+        return resps[0]["summary"]
+
+    def run(self, *batch) -> float:
+        assert self.handle is not None
+        leaves = jax.tree_util.tree_leaves(batch)
+        inline = {idx: np.asarray(v)
+                  for idx, v in zip(self._batch_leaf_idx, leaves)}
+        results = self._broadcast(
+            lambda c: c.execute_plan(self.handle, inline_args=inline))
+        self._step_count += 1
+        losses = [float(np.asarray(r["outputs"][0])) for r in results]
+        # Replicated loss: every process must agree.
+        assert max(losses) - min(losses) < 1e-5 * (abs(losses[0]) + 1e-9), (
+            f"divergent losses across hosts: {losses}")
+        return losses[0]
+
+    def variables(self):
+        results = self._broadcast(
+            lambda c: c.fetch_resource_vars(list(range(self._n_state))))
+        leaves = [results[0][i] for i in range(self._n_state)]
+        return jax.tree_util.tree_unflatten(self._state_tree, leaves)
+
+    def close(self) -> None:
+        for c in self.clients:
+            c.close()

@@ -65,9 +65,11 @@ class _CompiledPlan:
     """Server-side compiled plan + its argument routing metadata."""
 
     def __init__(self, step_fn, in_specs, topology, var_arg_indices,
-                 state_alias, out_is_state, n_invars, strategies_summary):
+                 state_alias, out_is_state, n_invars, strategies_summary,
+                 shardings=None):
         self.step_fn = step_fn
         self.in_specs = in_specs
+        self.shardings = shardings
         self.topology = topology
         self.var_arg_indices = var_arg_indices      # invar idx -> is variable
         self.state_alias = state_alias              # out idx -> invar idx
@@ -151,9 +153,11 @@ class TepdistServicer:
             "planner_seconds": round(time.time() - t0, 3),
             "n_constraints": len(splan.constraints),
         }
+        from jax.sharding import NamedSharding
+        shardings = [NamedSharding(mesh, spec) for spec in splan.in_specs]
         plan = _CompiledPlan(step_fn, splan.in_specs, topology, var_idx,
                              state_alias, out_is_state, len(graph.invars),
-                             summary)
+                             summary, shardings=shardings)
         handle = self.plan_cache.insert(plan)
         log.info("BuildExecutionPlan handle=%d %s", handle, summary)
         return protocol.pack({"handle": handle, "summary": summary})
@@ -189,6 +193,18 @@ class TepdistServicer:
                             for k, v in header["var_arg_map"].items()}
         return protocol.pack({"ok": True})
 
+    @staticmethod
+    def _place(value, sharding):
+        """Host value -> global jax.Array under ``sharding``. Works in both
+        single-controller and multi-controller (jax.distributed) modes: each
+        process materializes only its addressable shards from the full host
+        array (the TPU-native replacement for per-worker slice transfer)."""
+        if isinstance(value, jax.Array) and not isinstance(value, np.ndarray):
+            return value
+        arr = np.asarray(value)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
+
     # ------------------------------------------------------------------
     def ExecutePlan(self, request: bytes, context=None) -> bytes:
         t_exec0 = time.time()
@@ -209,13 +225,16 @@ class TepdistServicer:
             for i in range(plan.n_invars):
                 if i in inline:
                     meta = header["inline_meta"][str(i)]
-                    args.append(protocol.decode_literal(meta, blobs[inline[i]]))
+                    val = protocol.decode_literal(meta, blobs[inline[i]])
                 elif i in plan.var_arg_indices and i in self.variables:
-                    args.append(self.variables[i])
+                    val = self.variables[i]
                 elif i in self.inputs:
-                    args.append(self.inputs[i])
+                    val = self.inputs[i]
                 else:
                     raise KeyError(f"arg {i} neither transferred nor inline")
+                if plan.shardings is not None:
+                    val = self._place(val, plan.shardings[i])
+                args.append(val)
         with self._exec_lock:
             outs = plan.step_fn(*args)
             # Write aliased state back into the variable store (server-held).
@@ -262,8 +281,14 @@ class TepdistServicer:
                 idxs = sorted(self.variables)
             metas, out_blobs = [], []
             for i in idxs:
-                meta, blob = protocol.encode_literal(
-                    jax.device_get(self.variables[int(i)]))
+                val = self.variables[int(i)]
+                if (isinstance(val, jax.Array)
+                        and not val.is_fully_addressable):
+                    # Multi-controller: every process enters this gather in
+                    # the same order (clients broadcast FetchResourceVars).
+                    from jax.experimental import multihost_utils
+                    val = multihost_utils.process_allgather(val, tiled=True)
+                meta, blob = protocol.encode_literal(jax.device_get(val))
                 meta["global_idx"] = int(i)
                 metas.append(meta)
                 out_blobs.append(blob)
@@ -400,10 +425,24 @@ def main() -> None:
     parser.add_argument("--port", type=int, default=2222)
     parser.add_argument("--task_index", type=int, default=0)
     parser.add_argument("--platform", default="")
+    parser.add_argument("--coordinator_address", default="",
+                        help="host:port of the jax.distributed coordinator "
+                             "(enables multi-controller mode)")
+    parser.add_argument("--num_processes", type=int, default=1)
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     if args.platform:
         jax.config.update("jax_platforms", args.platform.lower())
+    if args.coordinator_address:
+        # PJRT multi-host initialization over DCN (the TPU-native replacement
+        # for the NCCL unique-id rendezvous; SURVEY §5.8).
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator_address,
+            num_processes=args.num_processes,
+            process_id=args.task_index)
+        log.info("jax.distributed: process %d/%d, %d global / %d local devices",
+                 args.task_index, args.num_processes,
+                 len(jax.devices()), len(jax.local_devices()))
     server, _, bound = create_server(args.port, task_index=args.task_index)
     server.start()
     print(f"tepdist server listening on {bound}", flush=True)
