@@ -161,6 +161,10 @@ class DistributedPipelineSession:
                 "plan_meta": plan_meta,
             }))
         self._step = 0
+        # Heartbeat monitor (surplus over the reference, which had no
+        # failure detection at all — SURVEY §5.3).
+        from tepdist_tpu.runtime.health import HealthMonitor
+        self.health = HealthMonitor(self.clients)
 
     def _wired_cots(self) -> List[List[int]]:
         out = []
@@ -244,11 +248,17 @@ class DistributedPipelineSession:
         for t in threads:
             t.join()
         if errors:
-            raise RuntimeError(f"worker failures: {errors}")
+            # Distinguish dead workers from transient RPC errors.
+            self.health.check_once()
+            self.health.dead |= set(errors)
+            raise RuntimeError(
+                f"worker failures: {errors}; dead={sorted(self.health.dead)}"
+                " — restore the cluster and resume from checkpoint")
         self._step += 1
         losses = results[self.loss_worker].get("losses", [])
         return float(sum(losses) / max(len(losses), 1))
 
     def close(self) -> None:
+        self.health.stop()
         for c in self.clients.values():
             c.close()

@@ -1,0 +1,99 @@
+"""Worker health monitoring: heartbeats + failure surfacing.
+
+Reference parity: NONE — the reference has no heartbeats, failure detection,
+or elasticity (SURVEY §5.3: "gRPC errors surface as CHECK failures"; recovery
+= checkpoint + restart). This module is deliberate surplus: a background
+heartbeat loop over the worker fleet that detects dead/unresponsive workers
+*between* steps, reports them through a callback, and arms the session's
+recovery path (restore-from-checkpoint after the cluster is restored —
+the same recovery contract the reference documents, minus the manual
+discovery of which worker died)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+log = logging.getLogger(__name__)
+
+
+class HealthMonitor:
+    """Periodic Ping over a set of TepdistClients."""
+
+    def __init__(self, clients: Dict[int, "object"],
+                 interval_s: float = 5.0,
+                 timeout_s: float = 3.0,
+                 max_misses: int = 2,
+                 on_failure: Optional[Callable[[int, Exception], None]] = None):
+        self.clients = clients
+        self.interval = interval_s
+        self.timeout = timeout_s
+        self.max_misses = max_misses
+        self.on_failure = on_failure
+        self.misses: Dict[int, int] = {ti: 0 for ti in clients}
+        self.dead: set = set()
+        self.last_seen: Dict[int, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def check_once(self) -> Dict[int, bool]:
+        """One synchronous sweep; returns {task_index: healthy}."""
+        status: Dict[int, bool] = {}
+        for ti, client in self.clients.items():
+            if ti in self.dead:
+                status[ti] = False
+                continue
+            try:
+                from tepdist_tpu.rpc import protocol
+                resp = client.stub.call("Ping", protocol.pack({}),
+                                        timeout=self.timeout)
+                header, _ = protocol.unpack(resp)
+                ok = bool(header.get("ok"))
+                if ok:
+                    self.misses[ti] = 0
+                    self.last_seen[ti] = time.time()
+                status[ti] = ok
+            except Exception as e:  # noqa: BLE001
+                self.misses[ti] = self.misses.get(ti, 0) + 1
+                status[ti] = False
+                if self.misses[ti] >= self.max_misses:
+                    self.dead.add(ti)
+                    log.error("worker %d declared dead after %d missed "
+                              "heartbeats: %s", ti, self.misses[ti], e)
+                    if self.on_failure is not None:
+                        try:
+                            self.on_failure(ti, e)
+                        except Exception:  # noqa: BLE001
+                            log.exception("on_failure callback raised")
+        return status
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                self.check_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="tepdist-heartbeat")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + 1)
+            self._thread = None
+
+    def healthy(self) -> bool:
+        return not self.dead
+
+    def assert_healthy(self) -> None:
+        if self.dead:
+            raise RuntimeError(
+                f"workers {sorted(self.dead)} are dead; restore the cluster "
+                "and resume from the last checkpoint (DoRemoteRestore)")

@@ -101,3 +101,28 @@ def test_two_worker_pipeline_matches_local(two_workers):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
         got, jax.device_get(p))
+
+
+def test_health_monitor_detects_dead_worker(two_workers):
+    ports = two_workers
+    from tepdist_tpu.rpc.client import TepdistClient
+    from tepdist_tpu.runtime.health import HealthMonitor
+
+    clients = {i: TepdistClient(f"127.0.0.1:{p}")
+               for i, p in enumerate(ports)}
+    failures = []
+    mon = HealthMonitor(clients, interval_s=0.5, timeout_s=2.0,
+                        max_misses=1,
+                        on_failure=lambda ti, e: failures.append(ti))
+    status = mon.check_once()
+    assert status == {0: True, 1: True}
+    assert mon.healthy()
+    # Point worker 1's client at a dead port.
+    dead = TepdistClient("127.0.0.1:1")  # nothing listens there
+    clients[1] = dead
+    mon.check_once()
+    assert 1 in mon.dead and failures == [1]
+    with pytest.raises(RuntimeError, match="dead"):
+        mon.assert_healthy()
+    for c in clients.values():
+        c.close()
