@@ -48,7 +48,13 @@ class PipelineExecutable:
         prog: PipelineProgram,
         devices: Optional[Sequence] = None,
         optimizer=None,
+        intra_stage_dp: bool = True,
     ):
+        """``intra_stage_dp``: shard the micro-batch dim over each stage's
+        device subset (PP x DP hybrid — the reference's nested split
+        ordinals, stage x spmd). Params stay replicated within a stage;
+        per-micro gradients come out partial and GSPMD inserts the
+        intra-stage psum at the GA/apply boundary."""
         self.prog = prog
         S = prog.num_stages
         devices = list(devices if devices is not None else jax.devices())
@@ -57,13 +63,24 @@ class PipelineExecutable:
         per = len(devices) // S
         self.stage_devices: List[Tuple[int, ...]] = []
         self.stage_meshes: List[Mesh] = []
-        self.stage_shardings: List[NamedSharding] = []
+        self.stage_shardings: List[NamedSharding] = []   # replicated
+        self.stage_batch_shardings: List[NamedSharding] = []
+        micro_rows = None
+        if prog.batch_flat_indices:
+            b0 = prog.graph.invars[prog.batch_flat_indices[0]]
+            micro_rows = b0.aval.shape[prog.batch_dim]
+        self.intra_dp = (intra_stage_dp and per > 1 and micro_rows is not None
+                         and micro_rows % per == 0)
         for s in range(S):
             devs = devices[s * per:(s + 1) * per]
             self.stage_devices.append(tuple(d.id for d in devs))
             mesh = Mesh(np.array(devs), axis_names=("intra",))
             self.stage_meshes.append(mesh)
             self.stage_shardings.append(NamedSharding(mesh, PartitionSpec()))
+            self.stage_batch_shardings.append(
+                NamedSharding(mesh, PartitionSpec("intra"))
+                if self.intra_dp else
+                NamedSharding(mesh, PartitionSpec()))
 
         self.dag, self.maps = build_pipeline_task_dag(
             prog, self.stage_devices)
@@ -213,6 +230,18 @@ class PipelineExecutable:
             val = jax.device_put(val, self.stage_shardings[s])
         return val
 
+    def _put_stage(self, s: int, val):
+        """Place a value on stage ``s``: micro-batch tensors (leading dim ==
+        micro rows) shard over the intra axis under PP x DP; everything else
+        replicates."""
+        if (self.intra_dp and hasattr(val, "ndim") and val.ndim >= 1):
+            micro_rows = self.prog.graph.invars[
+                self.prog.batch_flat_indices[0]].aval.shape[
+                self.prog.batch_dim]
+            if val.shape[0] == micro_rows:
+                return jax.device_put(val, self.stage_batch_shardings[s])
+        return jax.device_put(val, self.stage_shardings[s])
+
     def fetch_variables(self):
         assert self.params_tree is not None, "load_variables first"
         flat = [jax.device_get(self.var_store[i])
@@ -260,8 +289,7 @@ class PipelineExecutable:
                 if src[0] == "arg":
                     i = src[1]
                     if i in batch_set:
-                        val = jax.device_put(micro_slices[(m, i)],
-                                             self.stage_shardings[s])
+                        val = self._put_stage(s, micro_slices[(m, i)])
                     else:
                         val = self._stage_param(s, i)
                     args.append(val)
@@ -296,8 +324,7 @@ class PipelineExecutable:
                 outputs[tid] = (outputs[pid][oi],)
             elif tt == TaskType.RECV:
                 pid, oi = node.input_specs[0]
-                val = jax.device_put(outputs[pid][oi],
-                                     self.stage_shardings[s])
+                val = self._put_stage(s, outputs[pid][oi])
                 outputs[tid] = (val,)
             elif tt == TaskType.GAINIT:
                 outputs[tid] = (self._gainit[s](),)
