@@ -112,6 +112,62 @@ class _PipelineTrainingPlan(TrainingPlan):
         self._exe.load_variables(variables)
 
 
+def explore_parallelism(
+    loss_fn: Callable,
+    params,
+    *example_batch,
+    n_devices: int,
+    num_micro_batches: int = 4,
+) -> Dict[str, Any]:
+    """Full exploration (reference: RunExplorationlMode over DeviceSplitPlan
+    proposals incl. pipeline levels): evaluate SPMD mesh factorizations AND
+    pipeline-stage proposals under the analytic cost model; return the
+    winner as {"kind": "spmd"|"pipeline", ...}."""
+    from tepdist_tpu.graph.jaxpr_graph import trace_graph
+    from tepdist_tpu.parallel.auto_parallel import (
+        explore_topologies,
+        plan_axes,
+    )
+    from tepdist_tpu.parallel.evaluator import Evaluator
+    from tepdist_tpu.parallel.pipeline import plan_pipeline
+    from tepdist_tpu.runtime.execution_plan import build_pipeline_task_dag
+
+    grad_fn = jax.value_and_grad(loss_fn)
+    graph, _, _ = trace_graph(grad_fn, params, *example_batch)
+    candidates: List[Dict[str, Any]] = []
+    for topo in explore_topologies(n_devices):
+        try:
+            strategies = plan_axes(graph, topo, None, "cost")
+            cost = Evaluator(topo).run(graph, strategies)
+            candidates.append({"kind": "spmd", "topology": topo,
+                               "cost": cost})
+        except Exception as e:  # noqa: BLE001 — infeasible proposal
+            log.info("spmd proposal %s failed: %s", topo, e)
+    for S in (2, 4, 8):
+        if S > n_devices or n_devices % S:
+            continue
+        try:
+            prog = plan_pipeline(loss_fn, S, num_micro_batches, params,
+                                 *example_batch)
+            per = n_devices // S
+            stage_devs = [tuple(range(s * per, (s + 1) * per))
+                          for s in range(S)]
+            dag, _ = build_pipeline_task_dag(prog, stage_devs)
+            cost = Evaluator(MeshTopology([("stage", S)])).run_pipeline(dag)
+            candidates.append({"kind": "pipeline", "num_stages": S,
+                               "num_micro_batches": num_micro_batches,
+                               "cost": cost})
+        except Exception as e:  # noqa: BLE001
+            log.info("pipeline proposal S=%d failed: %s", S, e)
+    if not candidates:
+        raise RuntimeError("no feasible parallelism proposal")
+    best = min(candidates, key=lambda c: c["cost"].key())
+    log.info("exploration winner: %s (duration %.3e s/step) of %d proposals",
+             best["kind"], best["cost"].total_duration, len(candidates))
+    best["candidates"] = candidates
+    return best
+
+
 def plan_training(
     loss_fn: Callable,
     optimizer,
@@ -124,15 +180,44 @@ def plan_training(
     mode: Optional[str] = None,
     annotations: Optional[dict] = None,
     var_mem_limit: Optional[int] = None,
+    explore: bool = False,
 ) -> TrainingPlan:
     """Plan + compile a full training loop for ``loss_fn(params, *batch)``
-    with an optax ``optimizer``."""
+    with an optax ``optimizer``. ``explore=True`` (or OPT_LEVEL=2 with no
+    topology/stages given) searches SPMD *and* pipeline proposals."""
     env = ServiceEnv.get()
     devices = list(devices if devices is not None else jax.devices())
+    if explore and topology is None and num_stages is None:
+        best = explore_parallelism(
+            loss_fn, params, *example_batch, n_devices=len(devices),
+            num_micro_batches=num_micro_batches or 4)
+        if best["kind"] == "pipeline":
+            num_stages = best["num_stages"]
+            num_micro_batches = best["num_micro_batches"]
+        else:
+            topology = best["topology"]
     if num_stages is None:
         num_stages = env.num_stages if env.num_stages > 0 else 1
 
     import optax  # noqa: F401 — required peer
+
+    # REMAT_POLICY knob: rematerialization trades FLOPs for activation
+    # memory (jax.checkpoint; the stage modules already remat via VJP).
+    policy = env.remat_policy
+    if policy and policy != "none":
+        if policy in ("full", "true", "1"):
+            loss_fn = jax.checkpoint(loss_fn)
+        elif policy == "dots":
+            loss_fn = jax.checkpoint(
+                loss_fn,
+                policy=jax.checkpoint_policies.checkpoint_dots)
+        elif policy == "dots_no_batch":
+            loss_fn = jax.checkpoint(
+                loss_fn,
+                policy=jax.checkpoint_policies
+                .checkpoint_dots_with_no_batch_dims)
+        else:
+            log.warning("unknown REMAT_POLICY %r ignored", policy)
 
     def grad_fn(p, *b):
         return jax.value_and_grad(loss_fn)(p, *b)

@@ -168,3 +168,55 @@ def test_chrome_trace_export(tmp_path):
     data = json.load(open(path))
     assert data["traceEvents"]
     assert all("ts" in e and "dur" in e for e in data["traceEvents"])
+
+
+def test_explore_parallelism_full(devices):
+    import optax
+    from tepdist_tpu.train import explore_parallelism, plan_training
+
+    def loss(params, x, y):
+        h = jax.nn.relu(x @ params["w1"])
+        return jnp.mean((h @ params["w2"] - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    params = {"w1": jax.random.normal(k, (64, 64)) * 0.1,
+              "w2": jax.random.normal(k, (64, 64)) * 0.1}
+    x = jax.random.normal(k, (64, 64))
+    y = jnp.zeros((64, 64))
+    best = explore_parallelism(loss, params, x, y, n_devices=8)
+    kinds = {c["kind"] for c in best["candidates"]}
+    assert "spmd" in kinds and "pipeline" in kinds
+    assert best["cost"].memory_feasible
+
+    plan = plan_training(loss, optax.sgd(0.1), params, x, y,
+                         num_micro_batches=2, explore=True)
+    losses = [plan.step(x, y) for _ in range(3)]
+    assert losses[-1] < losses[0]
+
+
+def test_remat_policy_knob(devices):
+    import optax
+    from tepdist_tpu.core.service_env import ServiceEnv
+    from tepdist_tpu.train import plan_training
+
+    def loss(params, x, y):
+        h = jax.nn.relu(x @ params["w1"])
+        return jnp.mean((h @ params["w2"] - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    params = {"w1": jax.random.normal(k, (32, 32)) * 0.1,
+              "w2": jax.random.normal(k, (32, 32)) * 0.1}
+    x = jax.random.normal(k, (32, 32))
+    y = jnp.zeros((32, 32))
+    try:
+        ServiceEnv.reset({"REMAT_POLICY": "dots"})
+        plan_r = plan_training(loss, optax.sgd(0.1), params, x, y,
+                               num_micro_batches=1)
+        ServiceEnv.reset({"REMAT_POLICY": "none"})
+        plan_n = plan_training(loss, optax.sgd(0.1), params, x, y,
+                               num_micro_batches=1)
+        l_r = plan_r.step(x, y)
+        l_n = plan_n.step(x, y)
+        np.testing.assert_allclose(l_r, l_n, rtol=1e-5)
+    finally:
+        ServiceEnv.reset()
