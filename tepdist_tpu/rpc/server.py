@@ -306,7 +306,12 @@ class TepdistServicer:
         if meta is not None:
             from tepdist_tpu.rpc.worker_plan import StageModuleRuntime
             closed = deserialize_closed_jaxpr(blobs[0])
-            self.stage_modules[module_id] = StageModuleRuntime(closed, meta)
+            opt_init = opt_update = None
+            if len(blobs) >= 3:
+                opt_init = deserialize_closed_jaxpr(blobs[1])
+                opt_update = deserialize_closed_jaxpr(blobs[2])
+            self.stage_modules[module_id] = StageModuleRuntime(
+                closed, meta, opt_init=opt_init, opt_update=opt_update)
         return protocol.pack({"ok": True})
 
     def DispatchPlan(self, request: bytes, context=None) -> bytes:

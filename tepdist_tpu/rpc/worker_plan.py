@@ -63,12 +63,19 @@ class RawStore:
 
 
 class StageModuleRuntime:
-    """One received stage module: jitted forward + VJP backward."""
+    """One received stage module: jitted forward + VJP backward, plus the
+    optionally shipped optimizer init/update jaxprs (any optax chain runs
+    worker-side via the same wire format as the stage module)."""
 
-    def __init__(self, closed_jaxpr, meta: Dict[str, Any]):
+    def __init__(self, closed_jaxpr, meta: Dict[str, Any], opt_init=None,
+                 opt_update=None):
         from jax.extend.core import jaxpr_as_fun
 
         self.meta = meta
+        self.opt_init = (jax.jit(jaxpr_as_fun(opt_init))
+                         if opt_init is not None else None)
+        self.opt_update = (jax.jit(jaxpr_as_fun(opt_update))
+                           if opt_update is not None else None)
         fwd = jaxpr_as_fun(closed_jaxpr)
         self._fwd = jax.jit(fwd)
         n_in = len(closed_jaxpr.jaxpr.invars)
@@ -231,14 +238,16 @@ class WorkerPlan:
 
     def _apply(self, s: int, acc, extras=None) -> None:
         """Apply gradients for params OWNED by stage ``s`` only, summing
-        shared params' contributions from other stages' accumulators."""
-        meta = self.stages[s].meta
+        shared params' contributions from other stages' accumulators. Uses
+        the shipped optimizer jaxprs when present, SGD otherwise."""
+        stage = self.stages[s]
+        meta = stage.meta
         M = self.num_micro
-        lr = self.meta.get("learning_rate", 0.01)
-        owned = set(meta.get("owned_global_idx", meta["param_global_idx"]))
+        owned = meta.get("owned_global_idx", meta["param_global_idx"])
+        owned_set = set(owned)
         grads = {gi: jnp.asarray(g)
                  for gi, g in zip(meta["param_global_idx"], acc)
-                 if gi in owned}
+                 if gi in owned_set}
         for t, eacc in (extras or {}).items():
             t_meta = self.stages[t].meta if t in self.stages else None
             if t_meta is None:
@@ -246,6 +255,22 @@ class WorkerPlan:
             for gi, g in zip(t_meta["param_global_idx"], eacc):
                 if gi in grads:
                     grads[gi] = grads[gi] + jnp.asarray(g)
-        for gi, g in grads.items():
-            p = self.servicer.variables[gi]
-            self.servicer.variables[gi] = p - lr * (g / M)
+        grads = {gi: g / M for gi, g in grads.items()}
+        if stage.opt_update is not None and owned:
+            params_flat = [self.servicer.variables[gi] for gi in owned]
+            grads_flat = [grads[gi] for gi in owned]
+            if s not in getattr(self, "opt_states", {}):
+                self.opt_states = getattr(self, "opt_states", {})
+                self.opt_states[s] = list(stage.opt_init(*params_flat))
+            state = self.opt_states[s]
+            outs = stage.opt_update(*params_flat, *state, *grads_flat)
+            n_p = len(owned)
+            new_params = outs[:n_p]
+            self.opt_states[s] = list(outs[n_p:])
+            for gi, p in zip(owned, new_params):
+                self.servicer.variables[gi] = p
+        else:
+            lr = self.meta.get("learning_rate", 0.01)
+            for gi, g in grads.items():
+                p = self.servicer.variables[gi]
+                self.servicer.variables[gi] = p - lr * g

@@ -31,7 +31,12 @@ class DistributedPipelineSession:
     """Drive a pipeline across tepdist worker servers."""
 
     def __init__(self, prog: PipelineProgram, cluster: ClusterSpec,
-                 learning_rate: float = 0.01):
+                 learning_rate: float = 0.01, optimizer=None):
+        """``optimizer``: an optax GradientTransformation; its init and
+        update functions are TRACED per stage (over that stage's owned
+        params) and shipped to workers as serialized jaxprs — any optax
+        chain runs worker-side. Falls back to SGD(learning_rate) when None
+        (the reference's fixed-update posture)."""
         from tepdist_tpu.rpc.jaxpr_serde import serialize_closed_jaxpr
 
         self.prog = prog
@@ -130,9 +135,36 @@ class DistributedPipelineSession:
             }
             module = serialize_closed_jaxpr(
                 prog.decomp.stage_closed_jaxpr(s), inline=False)
+            blobs = [module]
+            if optimizer is not None:
+                owned_ppos = [p for p in ppos
+                              if owner_stage[mod.input_def_map[p][1]] == s]
+                owned_avals = [jax.ShapeDtypeStruct(
+                    mod.invars[p].aval.shape, mod.invars[p].aval.dtype)
+                    for p in owned_ppos]
+                if owned_avals:
+                    import optax as _optax
+
+                    def opt_init(plist):
+                        return optimizer.init(list(plist))
+
+                    def opt_update(plist, state, glist):
+                        updates, new_state = optimizer.update(
+                            list(glist), state, list(plist))
+                        return (_optax.apply_updates(list(plist), updates),
+                                new_state)
+
+                    init_closed = jax.make_jaxpr(opt_init)(owned_avals)
+                    state_shape = jax.eval_shape(opt_init, owned_avals)
+                    update_closed = jax.make_jaxpr(opt_update)(
+                        owned_avals, state_shape, owned_avals)
+                    meta["n_opt_state"] = len(
+                        jax.tree_util.tree_leaves(state_shape))
+                    blobs.append(serialize_closed_jaxpr(init_closed))
+                    blobs.append(serialize_closed_jaxpr(update_closed))
             self.clients[self.stage_worker[s]].stub.call(
                 "TransferModuleAndDefCtx",
-                protocol.pack({"module_id": s, "stage_meta": meta}, [module]))
+                protocol.pack({"module_id": s, "stage_meta": meta}, blobs))
 
         # Dispatch per-worker plans in global schedule order, with the GC
         # plan computed for that order (workers prune via mem_to_release).

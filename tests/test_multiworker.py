@@ -76,14 +76,13 @@ def test_two_worker_pipeline_matches_local(two_workers):
         WorkerSpec("127.0.0.1", ports[0], [0], task_index=0),
         WorkerSpec("127.0.0.1", ports[1], [0], task_index=1),
     ])
-    sess = DistributedPipelineSession(prog, cluster, learning_rate=0.1)
+    # Adam runs WORKER-side via the shipped optimizer jaxprs.
+    tx = optax.adam(1e-2)
+    sess = DistributedPipelineSession(prog, cluster, optimizer=tx)
     sess.load_variables(params)
     losses = [sess.step(x, y) for _ in range(3)]
     got = sess.fetch_variables()
     sess.close()
-
-    # Local reference: same pipeline semantics with plain SGD(0.1).
-    tx = optax.sgd(0.1)
 
     def apply_fn(pp, ss, g):
         u, ss = tx.update(g, ss, pp)
