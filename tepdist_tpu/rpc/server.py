@@ -182,8 +182,13 @@ class TepdistServicer:
         peer-to-peer activation pushes in the RPC transport)."""
         header, blobs = protocol.unpack(request)
         if "raw_key" in header:
-            arr = protocol.decode_literal(header["literal"], blobs[0])
-            self.raw_store.put(header["raw_key"], arr)
+            if "literals" in header:  # tuple payload (e.g. GA accumulators)
+                vals = tuple(protocol.decode_literal(m, blobs[i])
+                             for i, m in enumerate(header["literals"]))
+                self.raw_store.put(header["raw_key"], vals)
+            else:
+                arr = protocol.decode_literal(header["literal"], blobs[0])
+                self.raw_store.put(header["raw_key"], arr)
             return protocol.pack({"ok": True})
         return self.TransferToServerHost(request, context)
 

@@ -191,11 +191,22 @@ class WorkerPlan:
                     else:
                         from tepdist_tpu.rpc import protocol
 
-                        meta_l, blob = protocol.encode_literal(
-                            np.asarray(jax.device_get(val)))
+                        if isinstance(val, tuple):  # GA accumulator bundles
+                            metas, blobs = [], []
+                            for v in val:
+                                m, b = protocol.encode_literal(
+                                    np.asarray(jax.device_get(v)))
+                                metas.append(m)
+                                blobs.append(b)
+                            payload = protocol.pack(
+                                {"raw_key": key, "literals": metas}, blobs)
+                        else:
+                            meta_l, blob = protocol.encode_literal(
+                                np.asarray(jax.device_get(val)))
+                            payload = protocol.pack(
+                                {"raw_key": key, "literal": meta_l}, [blob])
                         self._peer(peer_worker).stub.call(
-                            "TransferHostRawData", protocol.pack(
-                                {"raw_key": key, "literal": meta_l}, [blob]))
+                            "TransferHostRawData", payload)
             elif tt == "recv":
                 parent = task["input_specs"].get("0")
                 if parent is not None and parent[0] in outputs:
@@ -248,11 +259,17 @@ class WorkerPlan:
         grads = {gi: jnp.asarray(g)
                  for gi, g in zip(meta["param_global_idx"], acc)
                  if gi in owned_set}
+        stage_param_gi = {int(k): v for k, v in
+                          self.meta.get("stage_param_gi", {}).items()}
         for t, eacc in (extras or {}).items():
-            t_meta = self.stages[t].meta if t in self.stages else None
-            if t_meta is None:
-                continue
-            for gi, g in zip(t_meta["param_global_idx"], eacc):
+            if t in self.stages:
+                t_gis = self.stages[t].meta["param_global_idx"]
+            else:
+                t_gis = stage_param_gi.get(t)
+                if t_gis is None:
+                    raise KeyError(
+                        f"no param index map for remote stage {t}")
+            for gi, g in zip(t_gis, eacc):
                 if gi in grads:
                     grads[gi] = grads[gi] + jnp.asarray(g)
         grads = {gi: g / M for gi, g in grads.items()}

@@ -91,13 +91,9 @@ class DistributedPipelineSession:
                 gi = mod.input_def_map[p][1]
                 if gi not in batch_set:
                     consumers.setdefault(gi, set()).add(self.stage_worker[s])
-        for gi, workers_of in consumers.items():
-            if len(workers_of) > 1:
-                raise NotImplementedError(
-                    f"param {gi} is shared by stages on workers "
-                    f"{sorted(workers_of)}; cross-worker shared-parameter "
-                    "gradient reduction is not implemented — co-locate the "
-                    "sharing stages on one worker")
+        # Cross-worker shared params are handled by grad Send/Recv pairs in
+        # the task DAG (build_pipeline_task_dag inserts them when the
+        # sharing stages' device groups differ).
         self._param_consumers = consumers
 
         # Stage meta + module shipping. Owner stage of each param = min
@@ -176,8 +172,16 @@ class DistributedPipelineSession:
                 (n for n in self.dag.nodes
                  if n.device_group and n.device_group[0] == ti),
                 key=lambda n: pos[n.id])
+            stage_param_gi = {}
+            for s2 in range(S):
+                mod2 = prog.stages[s2]
+                stage_param_gi[str(s2)] = [
+                    mod2.input_def_map[p][1]
+                    for p in mod2.param_positions()
+                    if mod2.input_def_map[p][1] not in batch_set]
             plan_meta = {
                 "task_index": ti,
+                "stage_param_gi": stage_param_gi,
                 "num_micro_batches": prog.num_micro_batches,
                 "cluster": {"workers": [
                     {"ip": x.ip, "port": x.port,

@@ -210,9 +210,28 @@ def build_pipeline_task_dag(
         for t in stages_of_i:
             if t == owner:
                 continue
-            dag.add_edge(dag.node(maps.ga_tasks[(t, M - 1)]),
-                         dag.node(maps.apply_tasks[owner]),
-                         out_idx=0, arg_pos=1 + t)
+            ga_last = dag.node(maps.ga_tasks[(t, M - 1)])
+            apply_node = dag.node(maps.apply_tasks[owner])
+            if tuple(stage_devices[t]) != tuple(stage_devices[owner]):
+                # Gradient contribution crosses device groups/workers:
+                # explicit Send/Recv pair (avoid duplicates when several
+                # params share the same stage pair).
+                key = (t, owner)
+                if key not in getattr(maps, "_grad_xfer", {}):
+                    if not hasattr(maps, "_grad_xfer"):
+                        maps._grad_xfer = {}
+                    send = dag.add(TaskType.SEND, f"send_grad_s{t}to{owner}",
+                                   stage=t, device_group=stage_devices[t])
+                    dag.add_edge(ga_last, send, out_idx=0, arg_pos=0)
+                    recv = dag.add(TaskType.RECV, f"recv_grad_s{t}to{owner}",
+                                   stage=owner,
+                                   device_group=stage_devices[owner])
+                    dag.add_edge(send, recv, out_idx=0, arg_pos=0)
+                    maps._grad_xfer[key] = recv.id
+                dag.add_edge(dag.node(maps._grad_xfer[key]), apply_node,
+                             out_idx=0, arg_pos=1 + t)
+            else:
+                dag.add_edge(ga_last, apply_node, out_idx=0, arg_pos=1 + t)
 
     merge = dag.add(TaskType.MERGE, "merge", device_group=())
     maps.merge_task = merge.id

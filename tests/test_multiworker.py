@@ -125,3 +125,46 @@ def test_health_monitor_detects_dead_worker(two_workers):
         mon.assert_healthy()
     for c in clients.values():
         c.close()
+
+
+def test_two_worker_tied_embeddings_gpt2(two_workers):
+    """Cross-worker shared parameters: GPT-2 ties wte between stage 0
+    (worker 0) and the last stage (worker 1); the gradient contribution
+    must travel worker1 -> worker0 and the owner applies the sum."""
+    ports = two_workers
+    from tepdist_tpu.models import gpt2
+
+    cfg = gpt2.CONFIGS["test"]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg, 4, 32)
+
+    def loss(p, t):
+        return gpt2.loss_fn(p, t, cfg)
+
+    prog = plan_pipeline(loss, 2, 2, params, tokens)
+    cluster = ClusterSpec([
+        WorkerSpec("127.0.0.1", ports[0], [0], task_index=0),
+        WorkerSpec("127.0.0.1", ports[1], [0], task_index=1),
+    ])
+    tx = optax.sgd(0.1)
+    sess = DistributedPipelineSession(prog, cluster, optimizer=tx)
+    sess.load_variables(params)
+    l0 = sess.step(tokens)
+    got = sess.fetch_variables()
+    sess.close()
+
+    def apply_fn(pp, ss, g):
+        u, ss = tx.update(g, ss, pp)
+        return optax.apply_updates(pp, u), ss
+
+    ref_step = jax.jit(prog.reference_step(apply_fn))
+    ref_l, ref_p, _ = ref_step(params, tx.init(params), tokens)
+    np.testing.assert_allclose(l0, float(ref_l), rtol=1e-4)
+    # wte (the tied embedding) must match the reference exactly.
+    np.testing.assert_allclose(
+        np.asarray(got["wte"]), np.asarray(jax.device_get(ref_p["wte"])),
+        rtol=1e-4, atol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
+        got, jax.device_get(ref_p))
