@@ -43,11 +43,20 @@ class TepdistSession:
     # ------------------------------------------------------------------
     def compile_train_step(self, step_fn: Callable, params, opt_state,
                            *example_batch,
-                           annotations: Optional[dict] = None) -> Dict:
+                           annotations: Optional[dict] = None,
+                           init_specs: Optional[dict] = None,
+                           init_seed: int = 0) -> Dict:
         """Trace + ship the whole training step; transfer initial state.
 
         ``step_fn(params, opt_state, *batch) -> (loss, params, opt_state)``.
-        """
+
+        ``init_specs``: {flat state index: {shape, dtype, distribution,
+        scale, mean, fan_in_scaling}} — variables are created SERVER-side
+        with shard-consistent RNG and never transferred (reference:
+        init_from_remote). ``params``/``opt_state`` may then be
+        jax.ShapeDtypeStruct pytrees. Indices absent from init_specs that
+        have real values are transferred; zero-init is assumed for abstract
+        optimizer slots."""
         closed, out_shape = jax.make_jaxpr(step_fn, return_shape=True)(
             params, opt_state, *example_batch)
         module = serialize_closed_jaxpr(closed)
@@ -75,6 +84,17 @@ class TepdistSession:
                          for ax, s in spec.items()}
                 for i, spec in annotations.items()
             }
+        init_specs = dict(init_specs or {})
+        if init_specs:
+            # Abstract optimizer slots default to zero init server-side.
+            for i, leaf in enumerate(state_leaves):
+                if i not in init_specs and not hasattr(leaf, "dtype"):
+                    raise TypeError(f"state leaf {i} has no dtype")
+                if i not in init_specs and isinstance(
+                        leaf, jax.ShapeDtypeStruct):
+                    init_specs[i] = {"shape": list(leaf.shape),
+                                     "dtype": str(leaf.dtype),
+                                     "distribution": "zeros"}
         resp = self.client.build_execution_plan(
             module,
             mesh_axes=self.mesh_axes,
@@ -82,11 +102,16 @@ class TepdistSession:
             state_alias=state_alias,
             mode=self.mode,
             annotations=ann_wire,
+            init_specs=init_specs or None,
+            init_seed=init_seed,
         )
         self.handle = resp["handle"]
 
-        # Variables transferred once; server holds them across steps.
+        # Variables not initialized remotely are transferred once; the
+        # server holds them across steps either way.
         for i, leaf in enumerate(state_leaves):
+            if i in init_specs:
+                continue
             self.client.transfer_to_server_host(np.asarray(leaf), i,
                                                 variable=True)
         self.client.transfer_var_arg_map(

@@ -74,6 +74,8 @@ class TepdistClient:
         mode: str = "cost",
         annotations: Optional[Dict[int, Dict[str, dict]]] = None,
         share_dev_flags: Optional[Sequence[bool]] = None,
+        init_specs: Optional[Dict[int, dict]] = None,
+        init_seed: int = 0,
     ) -> Dict[str, Any]:
         options = {
             "mesh_axes": [[a, n] for a, n in mesh_axes] or None,
@@ -83,6 +85,9 @@ class TepdistClient:
             "annotations": annotations,
             "share_dev_flags": list(share_dev_flags) if share_dev_flags
             else None,
+            "init_specs": ({str(k): v for k, v in init_specs.items()}
+                           if init_specs else None),
+            "init_seed": init_seed,
         }
         resp = self.stub.call("BuildExecutionPlan",
                               protocol.pack({"options": options},

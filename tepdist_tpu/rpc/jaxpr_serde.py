@@ -271,8 +271,13 @@ def _decode_jaxpr_struct(d: dict):
         eqns.append(_core.new_jaxpr_eqn(
             inv, outv, prim, params, effects=_core.no_effects))
     outvars = [dec_atom(a) for a in d["outvars"]]
-    return _core.Jaxpr(constvars=constvars, invars=invars, outvars=outvars,
-                       eqns=eqns)
+    import warnings
+    with warnings.catch_warnings():
+        # Deserialized jaxprs have no source program to point DebugInfo at;
+        # jax's default placeholder is exactly right here.
+        warnings.simplefilter("ignore", DeprecationWarning)
+        return _core.Jaxpr(constvars=constvars, invars=invars,
+                           outvars=outvars, eqns=eqns)
 
 
 def _encode_closed(closed) -> dict:

@@ -159,6 +159,21 @@ class TepdistServicer:
                              state_alias, out_is_state, len(graph.invars),
                              summary, shardings=shardings)
         handle = self.plan_cache.insert(plan)
+        # Server-side variable initialization (reference: init_from_remote
+        # grappler pass + init_specs_map — weights are created on the
+        # server's devices with shard-consistent RNG and NEVER travel).
+        init_specs = opts.get("init_specs") or {}
+        if init_specs:
+            from tepdist_tpu.runtime.initializers import init_from_spec
+            seed = int(opts.get("init_seed", 0))
+            key = jax.random.PRNGKey(seed)
+            with self._lock:
+                for idx_s, spec in init_specs.items():
+                    idx = int(idx_s)
+                    self.variables[idx] = init_from_spec(
+                        jax.random.fold_in(key, idx), spec,
+                        sharding=shardings[idx])
+            summary["initialized_vars"] = len(init_specs)
         log.info("BuildExecutionPlan handle=%d %s", handle, summary)
         return protocol.pack({"handle": handle, "summary": summary})
 

@@ -125,3 +125,38 @@ def test_ga_step_composes_with_auto_parallel(devices):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
         p, p_ref)
+
+
+def test_fp16_comm_compression():
+    from tepdist_tpu.core.service_env import ServiceEnv
+    import optax
+
+    loss_fn, params, x, y = _setup(batch=64, din=32, dh=96, dout=8)
+    tx = optax.sgd(0.1)
+
+    def grad_fn(p, x, y):
+        return jax.value_and_grad(loss_fn)(p, x, y)
+
+    def apply_fn(p, s, g):
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    try:
+        ServiceEnv.reset({"FP16_COMM": "1"})
+        step_c = build_ga_step(grad_fn, apply_fn, 4, batch_argnums=(1, 2))
+        ServiceEnv.reset({"FP16_COMM": "0"})
+        step_f = build_ga_step(grad_fn, apply_fn, 4, batch_argnums=(1, 2))
+        opt = tx.init(params)
+        lc, pc, _ = jax.jit(step_c)(params, opt, x, y)
+        lf, pf, _ = jax.jit(step_f)(params, opt, x, y)
+        # Compressed grads track full precision within bf16 tolerance.
+        np.testing.assert_allclose(np.asarray(lc), np.asarray(lf), rtol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-3),
+            pc, pf)
+        # And they genuinely differ (compression happened).
+        diff = float(jnp.abs(pc["w1"] - pf["w1"]).max())
+        assert diff > 0
+    finally:
+        ServiceEnv.reset()
