@@ -158,3 +158,32 @@ def test_smoke_models():
     xc = jax.random.normal(k, (4, 16, 16, 3))
     yc = jnp.zeros((4,), jnp.int32)
     assert np.isfinite(float(mlp.conv_loss(pc, xc, yc)))
+
+
+def test_moe_expert_parallelism_emerges_unannotated():
+    """EP must EMERGE from the cost planner (reference: 'emergent' AllToAll
+    dim strategies on GShard einsums) — no annotations."""
+    from tepdist_tpu.core.mesh import MeshTopology
+    from tepdist_tpu.graph.jaxpr_graph import trace_graph
+    from tepdist_tpu.parallel.auto_parallel import plan_axes
+
+    cfg = gpt_moe.MoEConfig(
+        base=gpt2.GPT2Config(vocab_size=512, n_ctx=128, n_embd=512,
+                             n_layer=2, n_head=8, dtype=jnp.float32),
+        num_experts=8, moe_every=1)
+    params = jax.eval_shape(lambda k: gpt_moe.init_params(cfg, k),
+                            jax.random.PRNGKey(0))
+    tokens = jax.ShapeDtypeStruct((8, 129), jnp.int32)
+    graph, _, _ = trace_graph(
+        jax.value_and_grad(lambda p, t: gpt_moe.loss_fn(p, t, cfg)),
+        params, tokens)
+    gs = plan_axes(graph, MeshTopology([("expert", 4)]))[0]
+    n_expert_splits = 0
+    for v in graph.invars:
+        s = gs.var_strategies.get(v)
+        if (s is not None and s.is_split() and len(v.aval.shape) == 3
+                and v.aval.shape[0] == cfg.num_experts
+                and s.partition_dim == 0):
+            n_expert_splits += 1
+    assert n_expert_splits >= 4, (
+        f"expert parallelism did not emerge ({n_expert_splits} splits)")
