@@ -19,7 +19,6 @@ import numpy as np
 
 import jax
 
-from tepdist_tpu.rpc import protocol
 from tepdist_tpu.rpc.client import TepdistClient
 from tepdist_tpu.rpc.jaxpr_serde import serialize_closed_jaxpr
 

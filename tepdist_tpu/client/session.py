@@ -14,7 +14,6 @@ its devices — the client needs no accelerator.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Callable, Dict, Optional, Sequence
 
 import numpy as np

@@ -11,11 +11,9 @@ sequence sharding. Both all-to-alls ride ICI; requires H % P == 0.
 from __future__ import annotations
 
 import functools
-import math
 from typing import Callable, Optional
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 

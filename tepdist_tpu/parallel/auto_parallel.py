@@ -16,7 +16,6 @@ tree, which later stages — pipeline decomposition, runtime — consume).
 from __future__ import annotations
 
 import dataclasses
-import itertools
 import logging
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 

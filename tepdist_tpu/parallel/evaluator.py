@@ -19,7 +19,7 @@ from tepdist_tpu.core.dist_spec import DimStrategy
 from tepdist_tpu.core.mesh import MeshTopology
 from tepdist_tpu.graph.cost import aval_bytes
 from tepdist_tpu.graph.jaxpr_graph import JaxprGraph
-from tepdist_tpu.parallel.cost_spmd_strategy import GraphStrategy, transition_cost
+from tepdist_tpu.parallel.cost_spmd_strategy import GraphStrategy
 from tepdist_tpu.parallel.performance_utils import PerfUtils, chip_spec
 
 

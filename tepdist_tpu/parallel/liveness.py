@@ -17,7 +17,6 @@ from typing import Dict, List
 
 from jax.extend import core as jexcore
 
-from tepdist_tpu.graph.cost import aval_bytes
 from tepdist_tpu.graph.jaxpr_graph import JaxprGraph
 
 Var = jexcore.Var

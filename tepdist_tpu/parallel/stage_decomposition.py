@@ -22,8 +22,6 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-import jax
-import jax.numpy as jnp
 from jax.extend import core as jexcore
 
 from tepdist_tpu.graph.jaxpr_graph import JaxprGraph

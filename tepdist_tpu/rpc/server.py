@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import argparse
 import os
-import json
 import logging
 import threading
 import time
@@ -133,7 +132,6 @@ class TepdistServicer:
                 int(i): {ax: DimStrategy(**d) for ax, d in spec.items()}
                 for i, spec in opts["annotations"].items()
             }
-        from tepdist_tpu.parallel.auto_parallel import _resolve_fixed  # noqa
         mode = opts.get("mode", "cost")
         strategies = plan_axes(graph, topology, annotations, mode)
         state_alias = {int(k): int(v)

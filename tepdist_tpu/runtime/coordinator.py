@@ -40,7 +40,6 @@ def serialize_task(node) -> dict:
 
 
 def deserialize_task_into(dag, d: dict) -> None:
-    from tepdist_tpu.core.mesh import SplitId
     from tepdist_tpu.runtime.task_graph import TaskType
 
     node = dag.add(TaskType(d["type"]), d["name"],

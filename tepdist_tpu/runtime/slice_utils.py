@@ -14,7 +14,7 @@ from typing import Dict, List, Sequence, Tuple
 import numpy as np
 
 from tepdist_tpu.core.dist_spec import TensorStrategy
-from tepdist_tpu.core.mesh import MeshTopology, SplitId
+from tepdist_tpu.core.mesh import MeshTopology
 
 
 def shard_shape(full_shape: Sequence[int], ts: TensorStrategy
