@@ -151,6 +151,64 @@ def loss_fn(params, tokens, cfg: GPT2Config, attn_impl=None):
     return jnp.mean(logz - gold)
 
 
+# --------------------------------------------------------------------------
+# Stacked-parameter form for the collective (single-program) pipeline:
+# per-layer block params stacked on a leading layer dim, shardable over a
+# 'stage' mesh axis (ops/collective_pipeline.py).
+# --------------------------------------------------------------------------
+
+def stack_block_params(params, cfg: GPT2Config):
+    """h0..hN per-layer dicts -> one dict of [L, ...] stacked leaves."""
+    keys = params["h0"].keys()
+    return {k: jnp.stack([params[f"h{i}"][k] for i in range(cfg.n_layer)])
+            for k in keys}
+
+
+def make_stage_fn(cfg: GPT2Config, layers_per_stage: int):
+    """Stage body for collective_pipeline: applies this stage's layer slice
+    (leading dim layers_per_stage) by scanning transformer_block."""
+
+    def stage_fn(stage_params, x):
+        def body(h, layer_params):
+            return transformer_block(layer_params, h, cfg), None
+
+        h, _ = jax.lax.scan(body, x, stage_params)
+        return h
+
+    return stage_fn
+
+
+def pipelined_loss_fn(params, stacked_blocks, tokens, cfg: GPT2Config,
+                      mesh, num_micro: int, axis: str = "stage"):
+    """Next-token CE with the block stack run as a collective pipeline.
+
+    ``params``: embedding/final-norm leaves (wte/wpe/ln_f_*), replicated.
+    ``stacked_blocks``: [S, L/S, ...] leaves sharded over ``axis``.
+    """
+    from tepdist_tpu.ops.collective_pipeline import collective_pipeline
+
+    S = mesh.shape[axis]
+    layers_per_stage = cfg.n_layer // S
+    B, Tfull = tokens.shape
+    T = Tfull - 1
+    inputs = tokens[:, :-1]
+    targets = tokens[:, 1:]
+    x = params["wte"][inputs] + params["wpe"][:T]
+    x = x.astype(cfg.dtype)
+    # Micro-batch the embedded activations: [M, mb, T, D].
+    mb = B // num_micro
+    x_micro = x.reshape(num_micro, mb, T, cfg.n_embd)
+    pipelined = collective_pipeline(
+        make_stage_fn(cfg, layers_per_stage), mesh, axis=axis)
+    y_micro = pipelined(stacked_blocks, x_micro)
+    y = y_micro.reshape(B, T, cfg.n_embd)
+    y = _layer_norm(y, params["ln_f_g"], params["ln_f_b"])
+    logits = (y @ params["wte"].T).astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
 def fake_batch(cfg: GPT2Config, batch_size: int, seq_len: Optional[int] = None,
                seed: int = 0):
     """FAKE_INPUT-mode batch (reference: fake_input configs / FAKE_INPUT env)."""

@@ -61,7 +61,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     o0 = jnp.zeros((B, H, Tl, D), jnp.float32)
     # Mark the accumulators as device-varying over the ring axis so the
     # fori_loop carry types match (shard_map varying-axis typing).
-    m0, l0, o0 = (jax.lax.pvary(x, (axis_name,)) for x in (m0, l0, o0))
+    m0, l0, o0 = (jax.lax.pcast(x, (axis_name,), to="varying")
+                  for x in (m0, l0, o0))
 
     perm = [(i, (i + 1) % P_) for i in range(P_)]
 
