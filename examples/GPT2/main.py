@@ -31,6 +31,10 @@ def main():
     parser.add_argument("--seq", type=int, default=512)
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--num_stages", type=int, default=0)
+    parser.add_argument("--pipeline", choices=["taskgraph", "collective"],
+                        default="taskgraph",
+                        help="taskgraph: 1F1B multi-program runtime; "
+                             "collective: single-jit shard_map+ppermute")
     parser.add_argument("--num_micro_batches", type=int, default=1)
     parser.add_argument("--mode", default="cost", choices=["cost", "rule"])
     args = parser.parse_args()
@@ -56,8 +60,45 @@ def main():
     params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
     tokens = gpt2.fake_batch(cfg, args.batch, args.seq)
     tx = optax.adamw(1e-4, b1=0.9, b2=0.95, weight_decay=0.01)
-    opt_state = tx.init(params)
 
+    if args.num_stages > 1 and args.pipeline == "collective":
+        import numpy as np
+        from jax.sharding import Mesh
+
+        S = args.num_stages
+        if len(jax.devices()) < S:
+            raise SystemExit(f"--num_stages {S} needs {S} devices, "
+                             f"have {len(jax.devices())}")
+        mesh = Mesh(np.array(jax.devices()[:S]), axis_names=("stage",))
+        embed, stacked = gpt2.shard_stacked_for_stages(params, cfg, mesh)
+        state = (embed, stacked)
+        opt = tx.init(state)
+        M = args.num_micro_batches if args.num_micro_batches > 0 else 2
+        if args.batch % M:
+            raise SystemExit(f"--batch {args.batch} not divisible by "
+                             f"--num_micro_batches {M}")
+
+        @jax.jit
+        def cstep(state, opt, tokens):
+            def loss(state):
+                e, b = state
+                return gpt2.pipelined_loss_fn(e, b, tokens, cfg, mesh, M)
+            l, g = jax.value_and_grad(loss)(state)
+            u, opt = tx.update(g, opt, state)
+            return l, optax.apply_updates(state, u), opt
+
+        l, state, opt = cstep(state, opt, tokens)
+        print(f"collective pipeline: S={S} M={M} compile+step0 "
+              f"loss={float(l):.4f}")
+        for i in range(args.steps):
+            t0 = time.perf_counter()
+            l, state, opt = cstep(state, opt, tokens)
+            l = float(l)
+            print(f"step {i}: loss={l:.4f} "
+                  f"({(time.perf_counter()-t0)*1e3:.1f} ms)")
+        return
+
+    opt_state = tx.init(params)
     if args.num_stages > 1:
         from tepdist_tpu.parallel.pipeline import plan_pipeline
         from tepdist_tpu.runtime.executor import PipelineExecutable

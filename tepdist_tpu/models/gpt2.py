@@ -164,6 +164,29 @@ def stack_block_params(params, cfg: GPT2Config):
             for k in keys}
 
 
+def shard_stacked_for_stages(params, cfg: GPT2Config, mesh,
+                             axis: str = "stage"):
+    """Split full params into (embed_leaves, stage-sharded stacked blocks)
+    for the collective pipeline. Validates device count and divisibility."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    S = mesh.shape[axis]
+    if len(mesh.devices.flat) != S:
+        raise ValueError(f"mesh axis {axis} has {S} entries but "
+                         f"{len(mesh.devices.flat)} devices")
+    if cfg.n_layer % S:
+        raise ValueError(f"n_layer={cfg.n_layer} not divisible by "
+                         f"{S} stages")
+    stacked = stack_block_params(params, cfg)
+    stacked = jax.tree_util.tree_map(
+        lambda a: a.reshape((S, cfg.n_layer // S) + a.shape[1:]), stacked)
+    sharding = NamedSharding(mesh, PartitionSpec(axis))
+    stacked = jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, sharding), stacked)
+    embed = {k: params[k] for k in ("wte", "wpe", "ln_f_g", "ln_f_b")}
+    return embed, stacked
+
+
 def make_stage_fn(cfg: GPT2Config, layers_per_stage: int):
     """Stage body for collective_pipeline: applies this stage's layer slice
     (leading dim layers_per_stage) by scanning transformer_block."""

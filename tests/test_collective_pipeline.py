@@ -112,14 +112,7 @@ def test_gpt2_collective_pipeline_matches_dense(stage_mesh):
     params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
     tokens = gpt2.fake_batch(cfg, 8, 32)
 
-    stacked = gpt2.stack_block_params(params, cfg)  # [L, ...]
-    S = 4
-    stacked = jax.tree_util.tree_map(
-        lambda a: a.reshape((S, cfg.n_layer // S) + a.shape[1:]), stacked)
-    stacked = jax.tree_util.tree_map(
-        lambda a: jax.device_put(a, NamedSharding(stage_mesh, P("stage"))),
-        stacked)
-    embed = {k: params[k] for k in ("wte", "wpe", "ln_f_g", "ln_f_b")}
+    embed, stacked = gpt2.shard_stacked_for_stages(params, cfg, stage_mesh)
 
     ref = gpt2.loss_fn(params, tokens, cfg)
     got = gpt2.pipelined_loss_fn(embed, stacked, tokens, cfg, stage_mesh,
