@@ -187,3 +187,21 @@ def test_moe_expert_parallelism_emerges_unannotated():
             n_expert_splits += 1
     assert n_expert_splits >= 4, (
         f"expert parallelism did not emerge ({n_expert_splits} splits)")
+
+
+def test_wrn_tensor_parallel_conv(devices):
+    """Conv feature-dim TP: WRN planned over a 'model' axis must execute
+    correctly (conv rhs o-feature split -> out feature split)."""
+    cfg = wide_resnet.CONFIGS[-1]
+    params = wide_resnet.init_params(cfg, jax.random.PRNGKey(0))
+    images, labels = wide_resnet.fake_batch(cfg, 8, image_size=32)
+
+    def loss(p, im, lb):
+        return wide_resnet.loss_fn(p, im, lb, cfg)
+
+    topo = MeshTopology([("model", 4)])
+    plan = auto_parallel(jax.value_and_grad(loss), topo, params, images,
+                         labels)
+    l_ref, _ = jax.value_and_grad(loss)(params, images, labels)
+    l, _ = plan.step(params, images, labels)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l_ref), rtol=1e-4)
