@@ -36,14 +36,14 @@ def init_attention(key, d=64, heads=4, dtype=jnp.float32):
     return {
         "qkv": (jax.random.normal(k1, (d, 3 * d)) / math.sqrt(d)).astype(dtype),
         "proj": (jax.random.normal(k2, (d, d)) / math.sqrt(d)).astype(dtype),
-        "heads": heads,
     }
 
 
-def attention_loss(params, x, y):
-    """One causal attention block + MSE (reference attention.py smoke test)."""
+def attention_loss(params, x, y, heads=4):
+    """One causal attention block + MSE (reference attention.py smoke test).
+    ``heads`` is static (not a differentiable leaf)."""
     B, T, D = x.shape
-    H = params["heads"]
+    H = heads
     hd = D // H
     qkv = x @ params["qkv"]
     q, k, v = jnp.split(qkv, 3, axis=-1)
