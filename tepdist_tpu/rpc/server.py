@@ -381,19 +381,37 @@ class TepdistServicer:
     def _do_save(self, opts) -> None:
         from tepdist_tpu.runtime.checkpoint import CheckpointUtil
         with self._lock:
+            data = {str(k): np.asarray(jax.device_get(v))
+                    for k, v in self.variables.items()}
+            # Worker-side optimizer slots (adam moments etc.) are part of
+            # the recoverable state.
+            if self.worker_plan is not None:
+                for stage, slots in getattr(self.worker_plan, "opt_states",
+                                            {}).items():
+                    for j, slot in enumerate(slots):
+                        data[f"opt:{stage}:{j}"] = np.asarray(
+                            jax.device_get(slot))
             CheckpointUtil(self.ckpt_dir,
                            max_to_keep=opts.get("max_to_keep", 5)).save(
-                opts.get("global_step", self.global_step),
-                {str(k): np.asarray(jax.device_get(v))
-                 for k, v in self.variables.items()})
+                opts.get("global_step", self.global_step), data,
+                worker_id=self.task_index)
 
     def _do_restore(self, opts) -> None:
         from tepdist_tpu.runtime.checkpoint import CheckpointUtil
         data, step = CheckpointUtil(self.ckpt_dir).restore(
-            opts.get("global_step", -1))
+            opts.get("global_step", -1), worker_id=self.task_index)
         with self._lock:
+            opt_states: Dict[int, Dict[int, Any]] = {}
             for k, v in data.items():
-                self.variables[int(k)] = v
+                if k.startswith("opt:"):
+                    _, stage, j = k.split(":")
+                    opt_states.setdefault(int(stage), {})[int(j)] = v
+                else:
+                    self.variables[int(k)] = v
+            if self.worker_plan is not None and opt_states:
+                self.worker_plan.opt_states = {
+                    stage: [slots[j] for j in sorted(slots)]
+                    for stage, slots in opt_states.items()}
             self.global_step = step
 
     def Ping(self, request: bytes, context=None) -> bytes:

@@ -215,19 +215,24 @@ class DistributedPipelineSession:
         return out
 
     # ------------------------------------------------------------------
-    def load_variables(self, params) -> None:
-        flat = jax.tree_util.tree_leaves(params)
+    def _assign_owners(self, params_template) -> Dict[int, set]:
+        flat = jax.tree_util.tree_leaves(params_template)
         self._n_params = len(flat)
-        self._params_tree = jax.tree_util.tree_structure(params)
+        self._params_tree = jax.tree_util.tree_structure(params_template)
         worker0 = self.cluster.workers[0].task_index
         self._owner = {}
-        pushed: Dict[int, set] = {}
+        placement: Dict[int, set] = {}
         for gi in range(self._n_params):
             workers = self._param_consumers.get(gi) or {worker0}
             self._owner[gi] = min(workers)
             for ti in workers:
-                pushed.setdefault(ti, set()).add(gi)
-        for ti, gis in pushed.items():
+                placement.setdefault(ti, set()).add(gi)
+        return placement
+
+    def load_variables(self, params) -> None:
+        flat = jax.tree_util.tree_leaves(params)
+        placement = self._assign_owners(params)
+        for ti, gis in placement.items():
             for gi in sorted(gis):
                 self.clients[ti].transfer_to_server_host(
                     np.asarray(flat[gi]), gi, variable=True)
@@ -293,6 +298,36 @@ class DistributedPipelineSession:
         self._step += 1
         losses = results[self.loss_worker].get("losses", [])
         return float(sum(losses) / max(len(losses), 1))
+
+    # ------------------------------------------------------------------
+    # Checkpoint + elastic recovery (beyond the reference: SURVEY §5.3
+    # documents recovery there as "checkpoint + restart the cluster" with
+    # no detection; here detection is HealthMonitor and resumption is one
+    # call against a repaired cluster).
+    def save(self, max_to_keep: int = 5) -> None:
+        """Every worker persists its own variables (per-worker shards,
+        reference: per-worker BundleWriter files)."""
+        for c in self.clients.values():
+            c.do_remote_save(max_to_keep=max_to_keep,
+                             global_step=self._step)
+
+    def restore(self, global_step: int = -1) -> None:
+        for c in self.clients.values():
+            c.do_remote_restore(global_step=global_step)
+
+    @classmethod
+    def resume(cls, prog, cluster, params_template, optimizer=None,
+               learning_rate=0.01, global_step: int = -1
+               ) -> "DistributedPipelineSession":
+        """Rebuild a session against a repaired cluster and restore every
+        worker's variables from its local checkpoint shards.
+        ``params_template``: pytree (values or ShapeDtypeStructs) giving the
+        parameter structure for ownership/fetch routing."""
+        sess = cls(prog, cluster, learning_rate=learning_rate,
+                   optimizer=optimizer)
+        sess._assign_owners(params_template)
+        sess.restore(global_step)
+        return sess
 
     def close(self) -> None:
         self.health.stop()
