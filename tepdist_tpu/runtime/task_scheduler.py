@@ -121,27 +121,40 @@ class TaskScheduler:
                 return r
         return self._simulate_py(window)
 
+    def _native_arrays(self):
+        """Marshal the DAG once per scheduler (schedule() simulates several
+        candidate windows; only `window` changes between them)."""
+        if getattr(self, "_marshalled", None) is None:
+            from tepdist_tpu import native
+
+            dag = self.dag
+            kind, dur, stage, micro, groups, children, n_parents = (
+                [], [], [], [], [], [], [])
+            for n in dag.nodes:
+                if n.task_type == TaskType.COMPUTE and "bwd" in n.name:
+                    kind.append(native.KIND_BWD)
+                elif n.task_type == TaskType.COMPUTE and "fwd" in n.name:
+                    kind.append(native.KIND_FWD)
+                else:
+                    kind.append(native.KIND_OTHER)
+                dur.append(self.task_time(n))
+                stage.append(n.stage)
+                micro.append(n.micro)
+                groups.append(list(n.device_group))
+                children.append(list(n.children))
+                n_parents.append(len(n.parents))
+            self._marshalled = (kind, dur, stage, micro, groups, children,
+                                n_parents)
+        return self._marshalled
+
     def _simulate_native(self, window: int) -> Optional[ScheduleResult]:
         """C++ simulation core (tepdist_tpu/native/scheduler.cc); produces
         bit-identical schedules to the Python loop (tested)."""
         from tepdist_tpu import native
 
         dag = self.dag
-        kind, dur, stage, micro, groups, children, n_parents = (
-            [], [], [], [], [], [], [])
-        for n in dag.nodes:
-            if n.task_type == TaskType.COMPUTE and "bwd" in n.name:
-                kind.append(native.KIND_BWD)
-            elif n.task_type == TaskType.COMPUTE and "fwd" in n.name:
-                kind.append(native.KIND_FWD)
-            else:
-                kind.append(native.KIND_OTHER)
-            dur.append(self.task_time(n))
-            stage.append(n.stage)
-            micro.append(n.micro)
-            groups.append(list(n.device_group))
-            children.append(list(n.children))
-            n_parents.append(len(n.parents))
+        (kind, dur, stage, micro, groups, children,
+         n_parents) = self._native_arrays()
         res = native.schedule_native(kind, dur, stage, micro, groups,
                                      children, n_parents, window)
         if res is None:
