@@ -28,7 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def _pipeline_local(stage_params, x_micro, *, stage_fn, axis: str,
-                    num_stages: int, num_micro: int):
+                    num_stages: int, num_micro: int, vary_axes=None):
     """Per-device body under shard_map: runs the GPipe wavefront.
 
     stage_params: this stage's params (leading stage dim of size 1 squeezed
@@ -64,8 +64,9 @@ def _pipeline_local(stage_params, x_micro, *, stage_fn, axis: str,
 
     state0 = jnp.zeros(mb_shape, x_micro.dtype)
     out0 = jnp.zeros((M,) + mb_shape, x_micro.dtype)
-    state0 = lax.pcast(state0, (axis,), to="varying")
-    out0 = lax.pcast(out0, (axis,), to="varying")
+    vary = tuple(vary_axes) if vary_axes else (axis,)
+    state0 = lax.pcast(state0, vary, to="varying")
+    out0 = lax.pcast(out0, vary, to="varying")
     (_, out_buf), _ = lax.scan(tick, (state0, out0), jnp.arange(T))
     # Only the last stage holds real outputs; psum makes them replicated.
     mask = (idx == S - 1).astype(x_micro.dtype)
@@ -76,30 +77,38 @@ def collective_pipeline(
     stage_fn: Callable,
     mesh: Mesh,
     axis: str = "stage",
+    data_axis: Optional[str] = None,
     stage_param_spec: Optional[Any] = None,
 ) -> Callable:
     """Build ``pipelined(stacked_params, x_micro) -> y_micro``.
 
     ``stacked_params``: pytree whose leaves have a leading stage dim of size
     S (sharded over ``axis`` — each device holds its stage's slice).
-    ``x_micro``: [M, mb, ...] micro-batched input (replicated).
+    ``x_micro``: [M, mb, ...] micro-batched input.
     ``stage_fn(params_slice, x) -> y`` with y.shape == x.shape.
+
+    ``data_axis``: optional second mesh axis for PP x DP hybrid — the
+    micro-batch row dim (dim 1 of x_micro) shards over it, params replicate
+    over it, and activations hop stage->stage WITHIN each data slice (the
+    reference's nested stage x spmd ordinals, one program).
     """
     S = mesh.shape[axis]
 
     def pipelined(stacked_params, x_micro):
         M = x_micro.shape[0]
+        vary = (axis,) + ((data_axis,) if data_axis else ())
         local = functools.partial(
             _pipeline_local, stage_fn=stage_fn, axis=axis,
-            num_stages=S, num_micro=M)
+            num_stages=S, num_micro=M, vary_axes=vary)
         param_specs = jax.tree_util.tree_map(
             lambda _: P(axis), stacked_params)
+        x_spec = P(None, data_axis) if data_axis else P()
         inner = jax.shard_map(
             lambda p, x: local(
                 jax.tree_util.tree_map(lambda a: a[0], p), x),
             mesh=mesh,
-            in_specs=(param_specs, P()),
-            out_specs=P(),
+            in_specs=(param_specs, x_spec),
+            out_specs=x_spec,
         )
         return inner(stacked_params, x_micro)
 

@@ -40,6 +40,19 @@ class ScheduleResult:
                 out.extend(tasks)
         return sorted(out, key=lambda t: self.start[t])
 
+    def show_per_device(self, dag, max_tasks: int = 0) -> str:
+        """Printable per-device static task lists (reference:
+        ShowPerDeviceTaskList, execution_plan.h:187, gated by DEBUG)."""
+        lines = []
+        devs = sorted({d for g in self.per_device for d in g})
+        for d in devs:
+            tasks = self.device_list(d)
+            if max_tasks:
+                tasks = tasks[:max_tasks]
+            names = [dag.node(t).key() for t in tasks]
+            lines.append(f"device {d}: " + " -> ".join(names))
+        return "\n".join(lines)
+
     def to_chrome_trace(self, dag, path: str) -> None:
         """Export the simulated schedule as a Chrome trace (chrome://tracing
         / Perfetto). The reference only had dot dumps + per-task logs

@@ -262,3 +262,25 @@ def test_elastic_recovery_after_worker_death(two_workers, tmp_path):
         l, p, s = ref_step(p, s, x, y)
         ref.append(float(l))
     np.testing.assert_allclose(losses, ref, rtol=1e-4)
+
+
+def test_execution_coordinator_fanout(two_workers):
+    """ExecutionCoordinator: mesh init, module transfer, and save fan-out
+    against a live 2-worker fleet (reference: master's client side)."""
+    ports = two_workers
+    from tepdist_tpu.runtime.coordinator import ExecutionCoordinator
+    from tepdist_tpu.rpc.jaxpr_serde import serialize_closed_jaxpr
+
+    cluster = ClusterSpec([
+        WorkerSpec("127.0.0.1", ports[0], [0], task_index=0),
+        WorkerSpec("127.0.0.1", ports[1], [0], task_index=1),
+    ])
+    coord = ExecutionCoordinator(cluster)
+    assert set(coord.clients) == {1}  # slaves only (master = task 0)
+    coord.init_mesh_topology()
+    closed = jax.make_jaxpr(lambda x: x * 2)(jnp.zeros((4,)))
+    coord.transfer_module(serialize_closed_jaxpr(closed), module_id=7)
+    coord.transfer_var_arg_map({0: 0})
+    results = coord.execute_remote_plan()
+    assert all(r.get("ok") for r in results)
+    coord.close()

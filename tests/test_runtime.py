@@ -186,3 +186,15 @@ def test_executor_intra_stage_dp_matches(prog, devices):
     assert len(sh.device_set) == 4
     from jax.sharding import PartitionSpec
     assert sh.spec == PartitionSpec("intra")
+
+
+def test_schedule_debug_dumps(prog, tmp_path):
+    p, *_ = prog
+    dag, _ = build_pipeline_task_dag(p, [(0, 1), (2, 3)])
+    sched = TaskScheduler(dag).schedule()
+    text = sched.show_per_device(dag, max_tasks=5)
+    assert "device 0:" in text and "->" in text
+    dot = tmp_path / "dag.dot"
+    dag.dump_dot(str(dot))
+    content = dot.read_text()
+    assert "digraph task_dag" in content and "fwd_s0_m0" in content
