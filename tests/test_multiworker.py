@@ -264,23 +264,51 @@ def test_elastic_recovery_after_worker_death(two_workers, tmp_path):
     np.testing.assert_allclose(losses, ref, rtol=1e-4)
 
 
-def test_execution_coordinator_fanout(two_workers):
+def test_execution_coordinator_fanout(tmp_path):
     """ExecutionCoordinator: mesh init, module transfer, and save fan-out
-    against a live 2-worker fleet (reference: master's client side)."""
-    ports = two_workers
+    against a FRESH 2-worker fleet (module fixture workers carry dispatched
+    plans from earlier tests, which ExecuteRemotePlan would re-run)."""
+    import time as _time
     from tepdist_tpu.runtime.coordinator import ExecutionCoordinator
+    from tepdist_tpu.rpc.client import TepdistClient
     from tepdist_tpu.rpc.jaxpr_serde import serialize_closed_jaxpr
 
-    cluster = ClusterSpec([
-        WorkerSpec("127.0.0.1", ports[0], [0], task_index=0),
-        WorkerSpec("127.0.0.1", ports[1], [0], task_index=1),
-    ])
-    coord = ExecutionCoordinator(cluster)
-    assert set(coord.clients) == {1}  # slaves only (master = task 0)
-    coord.init_mesh_topology()
-    closed = jax.make_jaxpr(lambda x: x * 2)(jnp.zeros((4,)))
-    coord.transfer_module(serialize_closed_jaxpr(closed), module_id=7)
-    coord.transfer_var_arg_map({0: 0})
-    results = coord.execute_remote_plan()
-    assert all(r.get("ok") for r in results)
-    coord.close()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["TEPDIST_CKPT_DIR"] = str(tmp_path)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ports, procs = [], []
+    for i in range(2):
+        port = _free_port()
+        ports.append(port)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "tepdist_tpu.rpc.server",
+             "--port", str(port), "--platform", "cpu",
+             "--task_index", str(i)],
+            env=env, cwd=root,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+    try:
+        for p in ports:
+            c = TepdistClient(f"127.0.0.1:{p}")
+            c.wait_ready(60)
+            c.close()
+        cluster = ClusterSpec([
+            WorkerSpec("127.0.0.1", ports[0], [0], task_index=0),
+            WorkerSpec("127.0.0.1", ports[1], [0], task_index=1),
+        ])
+        coord = ExecutionCoordinator(cluster)
+        assert set(coord.clients) == {1}  # slaves only (master = task 0)
+        coord.init_mesh_topology()
+        closed = jax.make_jaxpr(lambda x: x * 2)(jnp.zeros((4,)))
+        coord.transfer_module(serialize_closed_jaxpr(closed), module_id=7)
+        coord.transfer_var_arg_map({0: 0})
+        results = coord.execute_remote_plan()  # no plan dispatched: no-op ok
+        assert all(r.get("ok") for r in results)
+        coord.do_remote_save(max_to_keep=2, global_step=0)
+        coord.close()
+    finally:
+        for pr in procs:
+            pr.send_signal(signal.SIGKILL)
+            pr.wait()
