@@ -198,3 +198,30 @@ def test_schedule_debug_dumps(prog, tmp_path):
     dag.dump_dot(str(dot))
     content = dot.read_text()
     assert "digraph task_dag" in content and "fwd_s0_m0" in content
+
+
+def test_wrn_pipeline_heterogeneous_stages(devices):
+    """Conv nets have heterogeneous stages — exactly what the task-graph
+    pipeline (vs the homogeneous collective pipeline) exists for."""
+    from tepdist_tpu.models import wide_resnet as wrn
+
+    cfg = wrn.CONFIGS[-1]
+    params = wrn.init_params(cfg, jax.random.PRNGKey(0))
+    images, labels = wrn.fake_batch(cfg, 16, image_size=32)
+
+    def loss(p, im, lb):
+        return wrn.loss_fn(p, im, lb, cfg)
+
+    prog = plan_pipeline(loss, 2, 2, params, images, labels)
+    tx = optax.sgd(0.05)
+    exe = PipelineExecutable(prog, devices=devices, optimizer=tx)
+    exe.load_variables(params)
+    l0 = exe.step(images, labels)
+
+    def apply_fn(pp, ss, g):
+        u, ss = tx.update(g, ss, pp)
+        return optax.apply_updates(pp, u), ss
+
+    ref_step = jax.jit(prog.reference_step(apply_fn))
+    ref_l, _, _ = ref_step(params, tx.init(params), images, labels)
+    np.testing.assert_allclose(l0, float(ref_l), rtol=1e-4)
