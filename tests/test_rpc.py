@@ -224,3 +224,23 @@ def test_init_from_remote(server):
     losses = [sess.run(x, y) for _ in range(3)]
     assert losses[-1] < losses[0]
     sess.close()
+
+
+def test_periodic_variable_fetch(server):
+    """FETCH_RESOURCE_VAR_STEPS parity: ExecutePlan can return fetched
+    variables alongside the loss."""
+    port, _ = server
+    loss_fn, step, params, opt_state, x, y = _mlp_setup(batch=32)
+    sess = TepdistSession(f"127.0.0.1:{port}", mesh_axes=[("data", 4)])
+    sess.compile_train_step(step, params, opt_state, x, y)
+    result = sess.client.execute_plan(sess.handle,
+                                      inline_args={
+                                          idx: np.asarray(v) for idx, v in
+                                          zip(sess._batch_leaf_idx,
+                                              jax.tree_util.tree_leaves(
+                                                  (x, y)))},
+                                      fetch_resource_variables=True)
+    assert result["fetched"], "no variables came back with the step"
+    assert 0 in result["fetched"]
+    assert result["fetched"][0].shape == np.asarray(params["w1"]).shape
+    sess.close()
