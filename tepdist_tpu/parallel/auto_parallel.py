@@ -272,7 +272,7 @@ def auto_parallel_explore(
 
 
 def explore_topologies(
-    num_devices: int, max_levels: int = 2
+    num_devices: int, max_levels: int = 3
 ) -> List[MeshTopology]:
     """Mesh-shape proposals for exploration mode (reference:
     GenerateSplitProposals — factor device count into <=3 ordinals)."""
@@ -287,6 +287,20 @@ def explore_topologies(
             shapes.append((("data", num_devices // d), ("model", d)))
             shapes.append((("data", d), ("model", num_devices // d)))
         d += 1
+    # 3-level factorizations data x model x model2 (reference proposes up
+    # to 3 split ordinals, auto_parallel.cc:132-181).
+    if max_levels >= 3:
+        a = 2
+        while a * 4 <= num_devices:
+            rest = num_devices // a
+            if num_devices % a == 0:
+                b = 2
+                while b * b <= rest:
+                    if rest % b == 0:
+                        shapes.append((("data", a), ("model", rest // b),
+                                       ("model2", b)))
+                    b += 1
+            a += 1
     out = []
     seen = set()
     for axes in shapes:

@@ -164,6 +164,21 @@ class WorkerPlan:
             tt = task["type"]
             tid = task["node_id"]
             s = task["stage"]
+            try:
+                self._run_one(task, tt, tid, s, step, outputs, losses,
+                              stage_args)
+            except TimeoutError:
+                raise
+            except Exception as e:  # noqa: BLE001 — add task context
+                raise RuntimeError(
+                    f"worker {self.task_index} failed at task "
+                    f"{task['name']}#{tid} (step {step}): {e!r}") from e
+        self.raw.clear_step(step)
+        return {"losses": losses}
+
+    def _run_one(self, task, tt, tid, s, step, outputs, losses,
+                 stage_args) -> None:
+        if True:  # keeps the original dispatch chain intact below
             if tt == "compute" and task["name"].startswith("fwd"):
                 outs = self.stages[s].forward(*stage_args(task))
                 outputs[tid] = outs
@@ -244,8 +259,6 @@ class WorkerPlan:
             # GC: release buffers whose last (scheduled) consumer just ran.
             for rid in task.get("mem_to_release", []):
                 outputs.pop(rid, None)
-        self.raw.clear_step(step)
-        return {"losses": losses}
 
     def _apply(self, s: int, acc, extras=None) -> None:
         """Apply gradients for params OWNED by stage ``s`` only, summing

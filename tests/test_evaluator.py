@@ -220,3 +220,14 @@ def test_remat_policy_knob(devices):
         np.testing.assert_allclose(l_r, l_n, rtol=1e-5)
     finally:
         ServiceEnv.reset()
+
+
+def test_three_level_topology_proposals():
+    from tepdist_tpu.parallel.auto_parallel import explore_topologies
+
+    topos = explore_topologies(16)
+    names = [str(t) for t in topos]
+    assert any("model2" in n for n in names), names
+    # A 3-level proposal must be plannable end to end.
+    three = next(t for t in topos if "model2" in str(t))
+    assert three.num_devices == 16
