@@ -177,7 +177,15 @@ def test_moe_expert_parallelism_emerges_unannotated():
     graph, _, _ = trace_graph(
         jax.value_and_grad(lambda p, t: gpt_moe.loss_fn(p, t, cfg)),
         params, tokens)
-    gs = plan_axes(graph, MeshTopology([("expert", 4)]))[0]
+    # Under full-suite CPU load the default 5s ILP limit can trip into the
+    # greedy fallback; give the solver room so the assertion tests the
+    # planner, not the machine.
+    from tepdist_tpu.core.service_env import ServiceEnv
+    try:
+        ServiceEnv.reset({"ILP_TIME_LIMIT": "60"})
+        gs = plan_axes(graph, MeshTopology([("expert", 4)]))[0]
+    finally:
+        ServiceEnv.reset()
     n_expert_splits = 0
     for v in graph.invars:
         s = gs.var_strategies.get(v)
