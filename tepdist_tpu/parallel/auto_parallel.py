@@ -174,6 +174,42 @@ def apply_mem_save(
     return split
 
 
+def align_state_storage(
+    graph: JaxprGraph,
+    strategies: List[GraphStrategy],
+    state_alias: Dict[int, int],
+) -> int:
+    """Align variable STORAGE shardings with the strategy their updated
+    value is naturally produced in.
+
+    ``state_alias`` forces out spec := in spec for training-state threading
+    (SpmdTransform). When the planner leaves a variable replicated but its
+    update is computed sharded, that forcing inserts an all-gather of the
+    updated parameters EVERY step. Adopting the produced sharding as the
+    storage sharding removes the gather and shards the optimizer state
+    (ZeRO-flavored — the reference's mem-save direction, here driven by
+    consistency rather than a memory limit). Returns #vars realigned."""
+    changed = 0
+    for gs in strategies:
+        for oi, ii in state_alias.items():
+            if oi >= len(gs.out_strategies) or ii < 0:
+                continue
+            out_s = gs.out_strategies[oi]
+            a = graph.outvars[oi]
+            if out_s is None or not out_s.is_split():
+                continue
+            v = graph.invars[ii]
+            cur = gs.var_strategies.get(v)
+            if cur is not None and cur.is_split():
+                continue  # planner chose a storage split already
+            shape = v.aval.shape
+            if (out_s.partition_dim < len(shape)
+                    and shape[out_s.partition_dim] % out_s.num_splits == 0):
+                gs.var_strategies[v] = out_s
+                changed += 1
+    return changed
+
+
 def auto_parallel(
     fn: Callable,
     topology: MeshTopology,
@@ -197,6 +233,11 @@ def auto_parallel(
         annotations = None
     graph, in_tree, out_tree = trace_graph(fn, *example_args, **example_kwargs)
     strategies = plan_axes(graph, topology, annotations, mode)
+    if state_alias:
+        n_aligned = align_state_storage(graph, strategies, state_alias)
+        if n_aligned:
+            log.info("aligned %d state variables to their produced sharding",
+                     n_aligned)
     state_invars = sorted({ii for ii in (state_alias or {}).values()
                            if ii >= 0})
     if var_mem_limit is None and env.var_mem_limit > 0:

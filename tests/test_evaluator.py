@@ -231,3 +231,46 @@ def test_three_level_topology_proposals():
     # A 3-level proposal must be plannable end to end.
     three = next(t for t in topos if "model2" in str(t))
     assert three.num_devices == 16
+
+
+def test_state_storage_alignment(devices):
+    """When updates are produced sharded, param STORAGE adopts that
+    sharding (no per-step gather from state_alias forcing), and execution
+    still matches unsharded numerics."""
+    from tepdist_tpu.parallel.auto_parallel import auto_parallel
+    from jax.sharding import PartitionSpec
+
+    def loss(params, x, y):
+        h = jax.nn.relu(x @ params["w1"])
+        return jnp.mean((h @ params["w2"] - y) ** 2)
+
+    # Megatron regime (weights shard): trace-only at scale to check specs.
+    f32 = jnp.float32
+    big = {"w1": jax.ShapeDtypeStruct((8192, 8192), f32),
+           "w2": jax.ShapeDtypeStruct((8192, 8192), f32)}
+    x = jax.ShapeDtypeStruct((64, 8192), f32)
+    y = jax.ShapeDtypeStruct((64, 8192), f32)
+    fn = jax.value_and_grad(loss)
+    topo = MeshTopology([("model", 8)])
+    plan = auto_parallel(fn, topo, big, x, y, state_alias={1: 0, 2: 1})
+    in_specs = plan.sharding_plan.in_specs[:2]
+    out_specs = plan.sharding_plan.out_specs[1:3]
+    for i_spec, o_spec in zip(in_specs, out_specs):
+        assert i_spec == o_spec  # threading without reshard
+    assert any(s != PartitionSpec() for s in in_specs), in_specs
+
+    # Small executable check: numerics unchanged by alignment.
+    k = jax.random.PRNGKey(0)
+    params = {"w1": jax.random.normal(k, (64, 128)) * 0.1,
+              "w2": jax.random.normal(k, (128, 64)) * 0.1}
+    xs = jax.random.normal(k, (32, 64))
+    ys = jnp.zeros((32, 64))
+    plan2 = auto_parallel(fn, MeshTopology([("model", 4)]), params, xs, ys,
+                          state_alias={1: 0, 2: 1})
+    l_ref, g_ref = fn(params, xs, ys)
+    l, g = plan2.step(params, xs, ys)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l_ref), rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-6),
+        g, g_ref)
