@@ -139,7 +139,12 @@ class TepdistServicer:
         xform = SpmdTransform(graph, topology)
         splan = xform.lower(strategies, state_alias=state_alias)
         mesh = topology.to_jax_mesh(self.devices)
-        step_fn = xform.executable(splan, mesh)
+        # Donate aliased state buffers: the step's outputs replace them in
+        # the variable store, so the old buffers are dead — donation avoids
+        # double-buffering the parameters every step.
+        donate = tuple(sorted({ii for ii in state_alias.values()
+                               if ii >= 0}))
+        step_fn = xform.executable(splan, mesh, donate_invars=donate)
 
         var_idx = set(int(i) for i in opts.get("variable_indices", []))
         out_is_state = {oi: ii for oi, ii in state_alias.items()}
