@@ -1,0 +1,67 @@
+"""Wire protocol robustness: envelope round trips, malformed input
+rejection, literal dtype coverage (incl. bf16)."""
+
+import numpy as np
+import pytest
+
+from tepdist_tpu.rpc import protocol
+
+
+def test_envelope_round_trip():
+    header = {"a": 1, "nested": {"b": [1, 2, 3]}, "s": "x"}
+    blobs = [b"hello", b"", b"\x00" * 1024]
+    data = protocol.pack(header, blobs)
+    h2, b2 = protocol.unpack(data)
+    assert h2 == header
+    assert b2 == blobs
+
+
+def test_envelope_rejects_garbage():
+    with pytest.raises(ValueError, match="magic"):
+        protocol.unpack(b"NOPE" + b"\x00" * 64)
+
+
+def test_literal_dtypes():
+    import ml_dtypes
+
+    for arr in [
+        np.arange(6, dtype=np.float32).reshape(2, 3),
+        np.arange(6, dtype=np.int32),
+        np.array(1.5, dtype=np.float64),
+        np.ones((4,), dtype=np.bool_),
+        np.arange(4, dtype=np.float32).astype(ml_dtypes.bfloat16),
+    ]:
+        meta, blob = protocol.encode_literal(arr)
+        back = protocol.decode_literal(meta, blob)
+        assert back.dtype == arr.dtype
+        np.testing.assert_array_equal(np.asarray(back, np.float64),
+                                      np.asarray(arr, np.float64))
+
+
+def test_empty_blob_list():
+    data = protocol.pack({"only": "header"})
+    h, b = protocol.unpack(data)
+    assert h == {"only": "header"} and b == []
+
+
+def test_service_env_config_file(tmp_path, monkeypatch):
+    """Knobs loadable from a json config file with env taking precedence
+    (reference: LoadConfigFileSettings)."""
+    import json
+
+    from tepdist_tpu.core.service_env import ServiceEnv
+
+    cfg = tmp_path / "config.json"
+    cfg.write_text(json.dumps({"NUM_STAGES": 4, "ILP_TIME_LIMIT": 9.5}))
+    monkeypatch.setenv("TEPDIST_CONFIG", str(cfg))
+    try:
+        env = ServiceEnv.reset()
+        assert env.num_stages == 4
+        assert env.ilp_time_limit == 9.5
+        monkeypatch.setenv("NUM_STAGES", "2")  # env wins over file
+        env = ServiceEnv.reset()
+        assert env.num_stages == 2
+    finally:
+        monkeypatch.delenv("NUM_STAGES", raising=False)
+        monkeypatch.delenv("TEPDIST_CONFIG", raising=False)
+        ServiceEnv.reset()
