@@ -123,6 +123,28 @@ class WorkerPlan:
         # consumer task id -> (worker, key) routing for sends
         self.send_routes = {int(k): v for k, v in
                             plan_meta.get("send_routes", {}).items()}
+        # Intra-worker data parallelism: micro-batch-row tensors shard over
+        # this worker's local devices (the local executor's PP x DP,
+        # worker-side). Engaged when micro rows divide the device count.
+        self.micro_rows = plan_meta.get("micro_rows")
+        self._intra = None
+        devs = servicer.devices
+        if (self.micro_rows and len(devs) > 1
+                and self.micro_rows % len(devs) == 0):
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+            mesh = Mesh(np.array(devs), axis_names=("intra",))
+            self._intra = (NamedSharding(mesh, PartitionSpec("intra")),
+                           NamedSharding(mesh, PartitionSpec()))
+
+    def _place_local(self, val):
+        """Shard micro-batch tensors over local devices; replicate the rest."""
+        if self._intra is None:
+            return val
+        batch_sh, rep_sh = self._intra
+        if (hasattr(val, "ndim") and val.ndim >= 1
+                and val.shape[0] == self.micro_rows):
+            return jax.device_put(val, batch_sh)
+        return jax.device_put(val, rep_sh)
 
     def _peer(self, task_index: int):
         from tepdist_tpu.rpc.client import TepdistClient
@@ -149,8 +171,8 @@ class WorkerPlan:
                 if src[0] == "arg":
                     gi = src[1]
                     if gi in meta["batch_indices"]:
-                        args.append(self.raw.get(
-                            f"batch:{step}:{task['micro']}:{gi}"))
+                        args.append(self._place_local(self.raw.get(
+                            f"batch:{step}:{task['micro']}:{gi}")))
                     else:
                         args.append(self.servicer.variables[gi])
                 else:
@@ -229,7 +251,7 @@ class WorkerPlan:
                     outputs[tid] = (outputs[parent[0]][parent[1]],)
                 else:
                     key = self.meta["recv_keys"][str(tid)] + f":{step}"
-                    outputs[tid] = (self.raw.get(key),)
+                    outputs[tid] = (self._place_local(self.raw.get(key)),)
             elif tt == "ga_init":
                 meta = self.stages[s].meta
                 outputs[tid] = (tuple(

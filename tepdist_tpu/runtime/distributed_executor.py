@@ -179,9 +179,14 @@ class DistributedPipelineSession:
                     mod2.input_def_map[p][1]
                     for p in mod2.param_positions()
                     if mod2.input_def_map[p][1] not in batch_set]
+            micro_rows = None
+            if prog.batch_flat_indices:
+                b0 = prog.graph.invars[prog.batch_flat_indices[0]]
+                micro_rows = int(b0.aval.shape[prog.batch_dim])
             plan_meta = {
                 "task_index": ti,
                 "stage_param_gi": stage_param_gi,
+                "micro_rows": micro_rows,
                 "num_micro_batches": prog.num_micro_batches,
                 "cluster": {"workers": [
                     {"ip": x.ip, "port": x.port,
