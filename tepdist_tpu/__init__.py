@@ -20,11 +20,59 @@ __version__ = "0.1.0"
 from tepdist_tpu.core.dist_spec import DimStrategy, DistSpec, TensorStrategy
 from tepdist_tpu.core.mesh import MeshTopology, SplitId
 
+
+def __getattr__(name):
+    """Lazy top-level API (avoids importing jax-heavy modules at package
+    import): plan_training, sessions, planner entry points, ops."""
+    lazy = {
+        "plan_training": ("tepdist_tpu.train", "plan_training"),
+        "explore_parallelism": ("tepdist_tpu.train", "explore_parallelism"),
+        "auto_parallel": ("tepdist_tpu.parallel.auto_parallel",
+                          "auto_parallel"),
+        "auto_parallel_explore": ("tepdist_tpu.parallel.auto_parallel",
+                                  "auto_parallel_explore"),
+        "TepdistSession": ("tepdist_tpu.client.session", "TepdistSession"),
+        "MultiHostSession": ("tepdist_tpu.client.multihost",
+                             "MultiHostSession"),
+        "DistributedPipelineSession": (
+            "tepdist_tpu.runtime.distributed_executor",
+            "DistributedPipelineSession"),
+        "PipelineExecutable": ("tepdist_tpu.runtime.executor",
+                               "PipelineExecutable"),
+        "ring_attention": ("tepdist_tpu.ops.ring_attention",
+                           "ring_attention"),
+        "ulysses_attention": ("tepdist_tpu.ops.ulysses",
+                              "ulysses_attention"),
+        "collective_pipeline": ("tepdist_tpu.ops.collective_pipeline",
+                                "collective_pipeline"),
+        "flash_attention": ("tepdist_tpu.ops.pallas.flash_attention",
+                            "flash_attention"),
+    }
+    if name in lazy:
+        import importlib
+
+        module, attr = lazy[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'tepdist_tpu' has no attribute {name!r}")
+
+
 __all__ = [
     "DimStrategy",
     "DistSpec",
     "TensorStrategy",
     "MeshTopology",
     "SplitId",
+    "plan_training",
+    "explore_parallelism",
+    "auto_parallel",
+    "auto_parallel_explore",
+    "TepdistSession",
+    "MultiHostSession",
+    "DistributedPipelineSession",
+    "PipelineExecutable",
+    "ring_attention",
+    "ulysses_attention",
+    "collective_pipeline",
+    "flash_attention",
     "__version__",
 ]
