@@ -312,3 +312,45 @@ def test_execution_coordinator_fanout(tmp_path):
         for pr in procs:
             pr.send_signal(signal.SIGKILL)
             pr.wait()
+
+
+def test_four_stages_over_two_workers(two_workers):
+    """Stages interleave across workers (s % W): same-worker cross-stage
+    edges take the local passthrough path, remote ones the raw push —
+    both must compose to the reference trajectory."""
+    ports = two_workers
+
+    def loss_fn(params, x, y):
+        h = x
+        for i in range(4):
+            h = jnp.tanh(h @ params[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    k = jax.random.PRNGKey(3)
+    keys = jax.random.split(k, 6)
+    params = {f"w{i}": jax.random.normal(keys[i], (32, 32)) * 0.3
+              for i in range(4)}
+    x = jax.random.normal(keys[4], (16, 32))
+    y = jax.random.normal(keys[5], (16, 32))
+    prog = plan_pipeline(loss_fn, 4, 2, params, x, y)
+    cluster = ClusterSpec([
+        WorkerSpec("127.0.0.1", ports[0], [0], task_index=0),
+        WorkerSpec("127.0.0.1", ports[1], [0], task_index=1),
+    ])
+    tx = optax.sgd(0.1)
+    sess = DistributedPipelineSession(prog, cluster, optimizer=tx)
+    sess.load_variables(params)
+    losses = [sess.step(x, y) for _ in range(2)]
+    sess.close()
+
+    def apply_fn(pp, ss, g):
+        u, ss = tx.update(g, ss, pp)
+        return optax.apply_updates(pp, u), ss
+
+    ref_step = jax.jit(prog.reference_step(apply_fn))
+    p, s = params, tx.init(params)
+    ref = []
+    for _ in range(2):
+        l, p, s = ref_step(p, s, x, y)
+        ref.append(float(l))
+    np.testing.assert_allclose(losses, ref, rtol=1e-4)
