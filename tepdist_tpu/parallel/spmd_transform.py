@@ -76,6 +76,28 @@ class SpmdTransform:
         self.graph = graph
         self.topology = topology
 
+    @staticmethod
+    def _validate(ts: TensorStrategy, shape, axis_sizes) -> None:
+        """Reject shardings GSPMD would pad or misplace: every split dim
+        must exist and divide by the product of axis sizes on it (catches
+        bad user annotations before an opaque compile error)."""
+        per_dim = {}
+        for axis, s in ts.strategies.items():
+            if not s.is_split():
+                continue
+            d = s.partition_dim
+            if d >= len(shape):
+                raise ValueError(
+                    f"annotation splits dim {d} of a rank-{len(shape)} "
+                    f"tensor (axis {axis!r})")
+            per_dim[d] = per_dim.get(d, 1) * axis_sizes.get(axis,
+                                                            s.num_splits)
+        for d, factor in per_dim.items():
+            if shape[d] % factor:
+                raise ValueError(
+                    f"dim {d} (size {shape[d]}) not divisible by the "
+                    f"combined mesh factor {factor}")
+
     def lower(self, strategies: Sequence[GraphStrategy],
               state_alias: Optional[Dict[int, int]] = None) -> ShardingPlan:
         """``state_alias``: outvar index -> invar index for training-state
@@ -83,9 +105,11 @@ class SpmdTransform:
         forced to its input's sharding so step N's outputs feed step N+1
         without resharding."""
         combined = combine_axis_strategies(self.graph, strategies)
+        sizes = {gs.axis_name: gs.num_splits for gs in strategies}
         in_specs = []
         for v in self.graph.invars:
             ts = combined.get(v, TensorStrategy())
+            self._validate(ts, v.aval.shape, sizes)
             in_specs.append(ts.partition_spec(len(v.aval.shape)))
         out_specs: List[Optional[PartitionSpec]] = []
         for a in self.graph.outvars:

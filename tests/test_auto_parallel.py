@@ -111,3 +111,36 @@ def test_explore_topologies_enumeration():
     assert any("data=8" in n for n in names)
     assert any("model=8" in n for n in names)
     assert any("data=4" in n and "model=2" in n for n in names)
+
+
+def test_bad_annotation_rejected(devices):
+    """Invalid user annotations must fail at lower time with a clear error,
+    not as an opaque XLA compile failure."""
+    from tepdist_tpu.core.dist_spec import DimStrategy
+
+    fn, params, x, y = _mlp()
+    topo = MeshTopology([("data", 8)])
+    # y is (256, 32): splitting a nonexistent dim must be rejected.
+    with pytest.raises(ValueError, match="rank"):
+        auto_parallel(fn, topo, params, x, y,
+                      annotations={3: {"data": DimStrategy.split_on(5, 8)}},
+                      mode="rule")
+
+
+def test_annotation_builder(devices):
+    from tepdist_tpu.client.annotations import AnnotationBuilder
+
+    fn, params, x, y = _mlp()
+    ann = (AnnotationBuilder(params, x, y)
+           .split(lambda path, leaf: leaf.ndim == 2 and leaf.shape[0] == 256,
+                  0, "data", 8)
+           .replicate(lambda path, leaf: "w1" in path, "data", 8)
+           .build())
+    # x and y matched the split predicate (flat indices 2, 3).
+    assert set(ann) >= {2, 3}
+    assert ann[2]["data"].is_split()
+    plan = auto_parallel(fn, MeshTopology([("data", 8)]), params, x, y,
+                         annotations=ann, mode="rule")
+    l_ref, _ = fn(params, x, y)
+    l, _ = plan.step(params, x, y)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l_ref), rtol=1e-4)
