@@ -98,6 +98,11 @@ class TaskScheduler:
             ndev = max(len(n.device_group), 1)
             return max(PerfUtils.compute_time(n.flops / ndev, self.spec), 1e-7)
         if n.task_type in (TaskType.SEND, TaskType.RECV):
+            env = ServiceEnv.get()
+            if env.pp_bandwidth > 0:
+                # PP_BANDWIDTH knob: cross-stage transfer bandwidth override
+                # (reference: PP_BANDWIDTH GB/s, service_env.h:63).
+                return max(n.out_bytes / (env.pp_bandwidth * 1e9), 1e-7)
             return max(PerfUtils.ppermute_cost(n.out_bytes, self.spec), 1e-7)
         if n.task_type == TaskType.AR:
             ndev = max(len(n.device_group), 1)

@@ -143,22 +143,26 @@ def explore_parallelism(
                                "cost": cost})
         except Exception as e:  # noqa: BLE001 — infeasible proposal
             log.info("spmd proposal %s failed: %s", topo, e)
+    batch0 = jax.tree_util.tree_leaves(example_batch)[0]
+    batch_rows = batch0.shape[0]
     for S in (2, 4, 8):
         if S > n_devices or n_devices % S:
             continue
-        try:
-            prog = plan_pipeline(loss_fn, S, num_micro_batches, params,
-                                 *example_batch)
-            per = n_devices // S
-            stage_devs = [tuple(range(s * per, (s + 1) * per))
-                          for s in range(S)]
-            dag, _ = build_pipeline_task_dag(prog, stage_devs)
-            cost = Evaluator(MeshTopology([("stage", S)])).run_pipeline(dag)
-            candidates.append({"kind": "pipeline", "num_stages": S,
-                               "num_micro_batches": num_micro_batches,
-                               "cost": cost})
-        except Exception as e:  # noqa: BLE001
-            log.info("pipeline proposal S=%d failed: %s", S, e)
+        for M in {num_micro_batches, 2 * num_micro_batches}:
+            if batch_rows % M:
+                continue
+            try:
+                prog = plan_pipeline(loss_fn, S, M, params, *example_batch)
+                per = n_devices // S
+                stage_devs = [tuple(range(s * per, (s + 1) * per))
+                              for s in range(S)]
+                dag, _ = build_pipeline_task_dag(prog, stage_devs)
+                cost = Evaluator(
+                    MeshTopology([("stage", S)])).run_pipeline(dag)
+                candidates.append({"kind": "pipeline", "num_stages": S,
+                                   "num_micro_batches": M, "cost": cost})
+            except Exception as e:  # noqa: BLE001
+                log.info("pipeline proposal S=%d M=%d failed: %s", S, M, e)
     if not candidates:
         raise RuntimeError("no feasible parallelism proposal")
     best = min(candidates, key=lambda c: c["cost"].key())

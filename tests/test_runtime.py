@@ -225,3 +225,19 @@ def test_wrn_pipeline_heterogeneous_stages(devices):
     ref_step = jax.jit(prog.reference_step(apply_fn))
     ref_l, _, _ = ref_step(params, tx.init(params), images, labels)
     np.testing.assert_allclose(l0, float(ref_l), rtol=1e-4)
+
+
+def test_pp_bandwidth_knob(prog):
+    """PP_BANDWIDTH overrides cross-stage transfer cost in the simulator."""
+    from tepdist_tpu.core.service_env import ServiceEnv
+
+    p, *_ = prog
+    dag, _ = build_pipeline_task_dag(p, [(0,), (1,)])
+    try:
+        ServiceEnv.reset({"PP_BANDWIDTH": "0.0001"})  # 100 KB/s: sends slow
+        slow = TaskScheduler(dag).schedule().makespan
+        ServiceEnv.reset({"PP_BANDWIDTH": "1000"})
+        fast = TaskScheduler(dag).schedule().makespan
+        assert slow > fast * 2
+    finally:
+        ServiceEnv.reset()
