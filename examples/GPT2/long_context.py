@@ -37,6 +37,12 @@ def main():
 
     cfg = gpt2.CONFIGS[args.config]
     devices = jax.devices()
+    if args.impl == "ulysses":
+        # Ulysses needs heads % mesh == 0: use the largest valid divisor.
+        n = len(devices)
+        while cfg.n_head % n:
+            n -= 1
+        devices = devices[:n]
     mesh = Mesh(np.array(devices), axis_names=("seq",))
     print(f"sequence mesh: {len(devices)} devices, seq len {args.seq}")
 
