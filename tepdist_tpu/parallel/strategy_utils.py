@@ -160,6 +160,25 @@ def dim_maps(eqn) -> Optional[List[Dict[int, int]]]:
             maps.append({i: i for i in range(len(s)) if i != cdim})
         return maps
 
+    if name == "gather":
+        # Embedding-lookup pattern (wte[tokens]): operand [V, D], indices
+        # [...batch dims...], out [...batch dims..., D]. Batch dims of the
+        # INDICES map to the same output dims; the table is replicated.
+        # Only this shape is handled — general gathers stay bespoke-free.
+        dnums = eqn.params.get("dimension_numbers")
+        operand = eqn.invars[0]
+        indices = eqn.invars[1]
+        if (dnums is not None
+                and tuple(dnums.start_index_map) == (0,)
+                and tuple(dnums.collapsed_slice_dims) == (0,)
+                and len(_shape(operand)) == 2):
+            idx_rank = len(_shape(indices))
+            # indices last dim may be the index-vector dim (size 1).
+            n_batch = len(out_shape) - 1
+            m_idx = {i: i for i in range(min(idx_rank, n_batch))}
+            return [{}, m_idx]
+        return None
+
     if name in ("slice", "pad"):
         # Dims left whole map through; sliced/padded dims don't.
         in_shape = _shape(eqn.invars[0])

@@ -138,3 +138,24 @@ def test_gen_proposals_dot():
     assert len(partials) == 1
     replicated = [p for p in props if p.out_strategies[0].replicated]
     assert len(replicated) == 1
+
+
+def test_gather_embedding_lookup_propagation():
+    """Batch splits propagate THROUGH embedding lookups (wte[tokens])."""
+    wte = jnp.zeros((512, 64))
+    tokens = jnp.zeros((8, 16), jnp.int32)
+    eqn = _eqn(lambda w, t: w[t], wte, tokens, prim="gather")
+    r = StrategyUtil.forward_infer(eqn, {1: DimStrategy.split_on(0, 4)}, 4)
+    assert r is not None
+    assert r.out_strategies[0].partition_dim == 0
+    assert r.in_strategies[0].replicated  # the table
+    # Sequence-dim split propagates too.
+    r2 = StrategyUtil.forward_infer(eqn, {1: DimStrategy.split_on(1, 4)}, 4)
+    assert r2 is not None and r2.out_strategies[0].partition_dim == 1
+    # Back inference: batch-split output demands split indices.
+    rb = StrategyUtil.back_infer(eqn, DimStrategy.split_on(0, 4), 4)
+    assert rb is not None
+    assert rb.in_strategies[1].partition_dim == 0
+    # Splitting the feature (offset) dim is not expressible here.
+    rb2 = StrategyUtil.back_infer(eqn, DimStrategy.split_on(2, 4), 4)
+    assert rb2 is None
