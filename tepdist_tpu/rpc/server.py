@@ -162,6 +162,18 @@ class TepdistServicer:
                              state_alias, out_is_state, len(graph.invars),
                              summary, shardings=shardings)
         handle = self.plan_cache.insert(plan)
+        if ServiceEnv.get().debug:
+            # Reference parity: def-module text dumped per compile
+            # (service.cc:732-735) — here the planned jaxpr + specs.
+            dump_dir = os.environ.get("TEPDIST_DUMP_DIR", "/tmp/tepdist_dump")
+            try:
+                os.makedirs(dump_dir, exist_ok=True)
+                with open(os.path.join(dump_dir,
+                                       f"plan_{handle}.jaxpr.txt"), "w") as f:
+                    f.write(str(summary) + "\n\n")
+                    f.write(str(graph.jaxpr))
+            except OSError:
+                log.warning("could not write plan dump to %s", dump_dir)
         # Server-side variable initialization (reference: init_from_remote
         # grappler pass + init_specs_map — weights are created on the
         # server's devices with shard-consistent RNG and NEVER travel).

@@ -89,3 +89,25 @@ def test_planner_scales_to_345m():
     dt = time.time() - t0
     assert dt < 60, f"planner too slow: {dt:.1f}s"
     assert strategies[0].ilp_status in ("ilp", "greedy")
+
+
+def test_gpt2_example_json_config(tmp_path):
+    """examples/GPT2/main.py accepts reference-style json configs."""
+    import json
+    import subprocess
+
+    cfg = {"n_vocab": 256, "n_ctx": 64, "n_embd": 64, "n_layer": 2,
+           "n_head": 4, "input": "fake_input"}
+    path = tmp_path / "tiny.json"
+    path.write_text(json.dumps(cfg))
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    out = subprocess.run(
+        [sys.executable, "examples/GPT2/main.py", "--config", str(path),
+         "--batch", "8", "--seq", "32", "--steps", "1"],
+        capture_output=True, text=True, env=env, cwd=root, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "loss=" in out.stdout

@@ -267,3 +267,33 @@ def test_soak_many_steps_and_plans(server):
     np.testing.assert_allclose(losses1[0], losses2[0], rtol=1e-4)
     s1.close()
     s2.close()
+
+
+def test_debug_plan_dump(tmp_path):
+    """DEBUG-gated planned-module dump (reference: per-compile def-module
+    text files)."""
+    import jax.numpy as jnp
+
+    from tepdist_tpu.core.service_env import ServiceEnv
+    from tepdist_tpu.rpc.jaxpr_serde import serialize_closed_jaxpr
+    from tepdist_tpu.rpc.server import TepdistServicer
+    from tepdist_tpu.rpc import protocol
+
+    os.environ["TEPDIST_DUMP_DIR"] = str(tmp_path)
+    try:
+        ServiceEnv.reset({"DEBUG": "1"})
+        servicer = TepdistServicer(devices=jax.devices()[:4])
+        closed = jax.make_jaxpr(
+            lambda w, x: ((x @ w) ** 2).sum())(jnp.zeros((8, 8)),
+                                               jnp.zeros((4, 8)))
+        resp = servicer.BuildExecutionPlan(protocol.pack(
+            {"options": {"mesh_axes": [["data", 4]]}},
+            [serialize_closed_jaxpr(closed)]))
+        header, _ = protocol.unpack(resp)
+        dump = tmp_path / f"plan_{header['handle']}.jaxpr.txt"
+        assert dump.exists()
+        text = dump.read_text()
+        assert "dot_general" in text and "planner_seconds" in text
+    finally:
+        del os.environ["TEPDIST_DUMP_DIR"]
+        ServiceEnv.reset()
