@@ -39,6 +39,16 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
     ("ILP_TIME_LIMIT", float, 5.0, "ILP solver time limit (s)"),
     ("ILP_NUM_THREADS", int, 0, "ILP solver threads (0 = solver default)"),
     ("FAKE_INPUT", bool, False, "reuse first batch forever (benchmark mode)"),
+    # Accepted for config compatibility with the reference; no-ops on TPU
+    # (the mechanism they tune does not exist here — see help text).
+    ("BUFFER_SAVE", bool, False, "compat no-op: XLA owns buffer reuse"),
+    ("EARLY_GA", bool, False, "compat no-op: GA order is the scheduler's"),
+    ("ASYNC_RECV", bool, True, "compat no-op: PJRT dispatch is async"),
+    ("ASYNC_SEND", bool, True, "compat no-op: PJRT dispatch is async"),
+    ("MULTI_REORDER", bool, False, "compat no-op: candidate windows instead"),
+    ("DISABLE_BUFFER_ALIAS", bool, False,
+     "compat: disables state-buffer donation"),
+    ("DUMP_LLVM_PTX", bool, False, "compat no-op: no PTX on TPU"),
     ("FRONTEND", str, "JAX", "client frontend identifier"),
     ("FETCH_RESOURCE_VAR_STEPS", int, 0, "fetch vars to client every N steps"),
     # --- TPU-native knobs -------------------------------------------------

@@ -144,6 +144,8 @@ class TepdistServicer:
         # double-buffering the parameters every step.
         donate = tuple(sorted({ii for ii in state_alias.values()
                                if ii >= 0}))
+        if ServiceEnv.get().disable_buffer_alias:
+            donate = ()
         step_fn = xform.executable(splan, mesh, donate_invars=donate)
 
         var_idx = set(int(i) for i in opts.get("variable_indices", []))

@@ -191,6 +191,13 @@ def plan_training(
     topology/stages given) searches SPMD *and* pipeline proposals."""
     env = ServiceEnv.get()
     devices = list(devices if devices is not None else jax.devices())
+    # OPT_LEVEL (reference planner-effort switch): 0 = rule mode,
+    # 1 = cost planner on the given/default mesh, 2 = full exploration.
+    if mode is None and env.opt_level == 0:
+        mode = "rule"
+    if (not explore and env.opt_level >= 2 and topology is None
+            and num_stages is None):
+        explore = True
     if explore and topology is None and num_stages is None:
         best = explore_parallelism(
             loss_fn, params, *example_batch, n_devices=len(devices),

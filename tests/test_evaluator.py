@@ -274,3 +274,26 @@ def test_state_storage_alignment(devices):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-6),
         g, g_ref)
+
+
+def test_opt_level_knob(devices):
+    import optax
+    from tepdist_tpu.core.service_env import ServiceEnv
+    from tepdist_tpu.train import plan_training
+
+    def loss(params, x, y):
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    params = {"w": jax.random.normal(k, (32, 32)) * 0.1}
+    x = jax.random.normal(k, (64, 32))
+    y = jnp.zeros((64, 32))
+    try:
+        ServiceEnv.reset({"OPT_LEVEL": "0"})  # rule mode
+        plan = plan_training(loss, optax.sgd(0.1), params, x, y,
+                             num_micro_batches=1)
+        assert plan.parallel_plan.mode == "rule"
+        l0 = plan.step(x, y)
+        assert np.isfinite(l0)
+    finally:
+        ServiceEnv.reset()
