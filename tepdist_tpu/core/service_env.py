@@ -26,8 +26,8 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
     ("AUX_AFFINITY", bool, True, "variable<->optimizer-state affinity terms in ILP"),
     ("COST_FACTOR", float, 1.0, "scale factor on comm costs"),
     ("FP16_COMM", bool, False, "compress gradient all-reduce to bf16 [tpu: bf16]"),
-    ("NUM_GRADIENTS", int, -1, "override detected gradient count"),
-    ("FORWARD_SUB_GRAPH_NUM", int, -1, "cap on planner subgraph count"),
+    ("NUM_GRADIENTS", int, -1, "compat: gradients are detected structurally"),
+    ("FORWARD_SUB_GRAPH_NUM", int, -1, "compat: whole-graph ILP (no subgraph cut needed to 24k nodes)"),
     ("VAR_MEM_LIMIT", int, -1, "per-device variable bytes before ZeRO splitting"),
     ("OPT_LEVEL", int, 2, "planner effort: 0 rule, 1 config, 2 exploration"),
     ("UNBALANCED_RATIO", float, 8.0, "pipeline stage flops imbalance tolerance"),
@@ -37,7 +37,7 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
     ("GROUP_SCHED_COUNT", int, 3, "candidate schedules tried by TaskScheduler"),
     ("PP_BANDWIDTH", float, 16.0, "pipeline xfer bandwidth GB/s (DCN override)"),
     ("ILP_TIME_LIMIT", float, 5.0, "ILP solver time limit (s)"),
-    ("ILP_NUM_THREADS", int, 0, "ILP solver threads (0 = solver default)"),
+    ("ILP_NUM_THREADS", int, 0, "compat: scipy/HiGHS milp is single-threaded"),
     ("FAKE_INPUT", bool, False, "reuse first batch forever (benchmark mode)"),
     # Accepted for config compatibility with the reference; no-ops on TPU
     # (the mechanism they tune does not exist here — see help text).

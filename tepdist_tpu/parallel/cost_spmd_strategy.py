@@ -45,24 +45,28 @@ log = logging.getLogger(__name__)
 def transition_cost(src: Optional[DimStrategy], dst: Optional[DimStrategy],
                     bytes_: float, num_splits: int, spec=None) -> float:
     """Cost of converting a tensor from ``src`` to ``dst`` layout on one mesh
-    axis (reference: ConeStrategy::BuildInputCost reshard edges)."""
+    axis (reference: ConeStrategy::BuildInputCost reshard edges). Scaled by
+    the COST_FACTOR knob (comm-cost bias, reference service_env.h)."""
     spec = spec or chip_spec()
+    factor = ServiceEnv.get().cost_factor
     if src is None or dst is None:
         return 0.0
     if src.partial:
         if dst.partial:
             return 0.0
         if dst.is_split():
-            return PerfUtils.reduce_scatter_cost(bytes_, num_splits, spec)
-        return PerfUtils.all_reduce_cost(bytes_, num_splits, spec)
+            return factor * PerfUtils.reduce_scatter_cost(
+                bytes_, num_splits, spec)
+        return factor * PerfUtils.all_reduce_cost(bytes_, num_splits, spec)
     if src.is_split():
         if dst.is_split():
             if dst.partition_dim == src.partition_dim:
                 return 0.0
-            return PerfUtils.all_to_all_cost(bytes_ / num_splits, num_splits, spec)
+            return factor * PerfUtils.all_to_all_cost(
+                bytes_ / num_splits, num_splits, spec)
         if dst.partial:
             return 0.0  # split value reinterpreted as partial: zero-pad free
-        return PerfUtils.all_gather_cost(bytes_, num_splits, spec)
+        return factor * PerfUtils.all_gather_cost(bytes_, num_splits, spec)
     # src replicated/glue
     return 0.0  # local slice or reuse
 
@@ -240,7 +244,9 @@ class CostSpmdStrategy:
         # all-reduce; for a contraction-split fwd dot it is the activation
         # psum) — reference: CreateAllReduceSpec on partial edges.
         if proposal.partial_output:
-            cost += PerfUtils.all_reduce_cost(root.out_bytes(), self.n, self.spec)
+            cost += (self.env.cost_factor *
+                     PerfUtils.all_reduce_cost(root.out_bytes(), self.n,
+                                               self.spec))
         return ConeStrategy(proposal, internal, boundary, cost)
 
     def _enumerate_cone_strategies(self, cones: List[InstCone]) -> None:

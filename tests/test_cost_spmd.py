@@ -111,3 +111,20 @@ def test_transition_cost_shapes():
     assert transition_cost(s0, s1, b, 8, spec) > 0           # all-to-all
     assert transition_cost(par, rep, b, 8, spec) > transition_cost(
         par, s0, b, 8, spec) > 0                             # AR > RS
+
+
+def test_cost_factor_knob():
+    from tepdist_tpu.core.service_env import ServiceEnv
+    from tepdist_tpu.parallel.performance_utils import chip_spec
+
+    spec = chip_spec("v5e")
+    s0 = DimStrategy.split_on(0, 8)
+    rep = DimStrategy.make_replicated(8)
+    try:
+        ServiceEnv.reset({"COST_FACTOR": "1.0"})
+        base = transition_cost(s0, rep, 1 << 20, 8, spec)
+        ServiceEnv.reset({"COST_FACTOR": "3.0"})
+        scaled = transition_cost(s0, rep, 1 << 20, 8, spec)
+        assert scaled == pytest.approx(3.0 * base)
+    finally:
+        ServiceEnv.reset()
