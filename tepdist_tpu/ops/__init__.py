@@ -1,4 +1,14 @@
+from tepdist_tpu.ops.collective_pipeline import (
+    collective_pipeline,
+    sequential_reference,
+)
 from tepdist_tpu.ops.ring_attention import reference_attention, ring_attention
 from tepdist_tpu.ops.ulysses import ulysses_attention
 
-__all__ = ["ring_attention", "ulysses_attention", "reference_attention"]
+__all__ = [
+    "ring_attention",
+    "ulysses_attention",
+    "reference_attention",
+    "collective_pipeline",
+    "sequential_reference",
+]

@@ -159,3 +159,40 @@ def test_gather_embedding_lookup_propagation():
     # Splitting the feature (offset) dim is not expressible here.
     rb2 = StrategyUtil.back_infer(eqn, DimStrategy.split_on(2, 4), 4)
     assert rb2 is None
+
+
+def test_forward_backward_consistency_fuzz():
+    """For every op in a small zoo: forward inference from a split operand,
+    then backward inference from the produced output, must agree on the
+    operand's strategy (the transfer functions are mutually consistent)."""
+    cases = [
+        (lambda a, b: a + b, (jnp.zeros((8, 4)), jnp.zeros((8, 4)))),
+        (lambda a, b: a * b, (jnp.zeros((8, 4)), jnp.zeros((8, 4)))),
+        (lambda a: jnp.tanh(a), (jnp.zeros((8, 4)),)),
+        (lambda a: a.T, (jnp.zeros((8, 4)),)),
+        (lambda a: a.reshape(8, 2, 2), (jnp.zeros((8, 4)),)),
+        (lambda a: jnp.concatenate([a, a], 1), (jnp.zeros((8, 4)),)),
+        (lambda x, w: x @ w, (jnp.zeros((8, 4)), jnp.zeros((4, 6)))),
+        (lambda a: a.sum(axis=1), (jnp.zeros((8, 4)),)),
+    ]
+    for fn, args in cases:
+        graph, _, _ = trace_graph(fn, *args)
+        for node in graph.nodes:
+            for i, a in enumerate(node.eqn.invars):
+                shape = getattr(a.aval, "shape", ())
+                for d in range(len(shape)):
+                    if shape[d] % 2:
+                        continue
+                    s = DimStrategy.split_on(d, 2)
+                    r = StrategyUtil.forward_infer(node.eqn, {i: s}, 2)
+                    if r is None:
+                        continue
+                    out = r.out_strategies[0]
+                    if not out.is_split():
+                        continue
+                    rb = StrategyUtil.back_infer(node.eqn, out, 2)
+                    assert rb is not None, (node.prim, d)
+                    back = rb.in_strategies[i]
+                    assert back is not None, (node.prim, d)
+                    assert back.partition_dim == s.partition_dim, (
+                        node.prim, d, str(back), str(s))
