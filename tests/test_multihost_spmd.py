@@ -131,3 +131,30 @@ def test_multihost_tensor_parallel(fleet):
         expected.append(float(l))
     np.testing.assert_allclose(remote, expected, rtol=1e-4)
     sess.close()
+
+
+def test_multihost_soak_gpt2(fleet):
+    """Longer multi-host soak: GPT-2 test config, 10 steps across the
+    2-process fleet; losses decrease and stay consistent across hosts."""
+    ports, procs = fleet
+    from tepdist_tpu.models import gpt2
+
+    cfg = gpt2.CONFIGS["test"]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg, 8, 32)
+    tx = optax.adam(1e-3)
+
+    def step(params, opt_state, tokens):
+        l, g = jax.value_and_grad(
+            lambda p: gpt2.loss_fn(p, tokens, cfg))(params)
+        u, opt_state = tx.update(g, opt_state, params)
+        return l, optax.apply_updates(params, u), opt_state
+
+    sess = MultiHostSession([f"127.0.0.1:{p}" for p in ports],
+                            mesh_axes=[("data", 8)])
+    sess.wait_ready(timeout=120)
+    sess.compile_train_step(step, params, tx.init(params), tokens)
+    losses = [sess.run(tokens) for _ in range(10)]
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(l) for l in losses)
+    sess.close()
