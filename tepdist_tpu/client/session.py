@@ -25,6 +25,11 @@ from tepdist_tpu.rpc.client import TepdistClient
 from tepdist_tpu.rpc.jaxpr_serde import serialize_closed_jaxpr
 
 
+def _is_abstract(tree) -> bool:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return bool(leaves) and isinstance(leaves[0], jax.ShapeDtypeStruct)
+
+
 class TepdistSession:
     def __init__(self, address: Optional[str] = None,
                  mesh_axes: Sequence = (), mode: str = "cost"):
@@ -116,6 +121,38 @@ class TepdistSession:
         self.client.transfer_var_arg_map(
             {i: i for i in range(self._n_state)})
         return resp["summary"]
+
+    # ------------------------------------------------------------------
+    def compile_training(self, loss_fn, optimizer, params, *example_batch,
+                         num_micro_batches: int = 1,
+                         annotations=None, init_specs=None,
+                         init_seed: int = 0):
+        """Remote counterpart of ``plan_training``: give a loss function
+        and an optax optimizer; the full training step (gradients + GA scan
+        + optimizer apply) is composed client-side, traced, and shipped —
+        the server plans/compiles/executes it and holds all state."""
+        import optax
+
+        from tepdist_tpu.parallel.sync_free import build_ga_step
+
+        def grad_fn(p, *b):
+            return jax.value_and_grad(loss_fn)(p, *b)
+
+        def apply_fn(p, s, g):
+            updates, s = optimizer.update(g, s, p)
+            return optax.apply_updates(p, updates), s
+
+        n_batch = len(example_batch)
+        step_fn = build_ga_step(
+            grad_fn, apply_fn, num_micro_batches,
+            batch_argnums=tuple(range(1, 1 + n_batch)))
+        opt_state = (optimizer.init(params)
+                     if not _is_abstract(params)
+                     else jax.eval_shape(optimizer.init, params))
+        return self.compile_train_step(
+            step_fn, params, opt_state, *example_batch,
+            annotations=annotations, init_specs=init_specs,
+            init_seed=init_seed)
 
     # ------------------------------------------------------------------
     def run(self, *batch) -> float:

@@ -115,6 +115,32 @@ def inline_calls(jaxpr, max_depth: int = 16):
                 env[call_out] = subst(sub_out, inner_env)
         else:
             new_invars = [subst(a, env) for a in eqn.invars]
+            # Control-flow sub-jaxprs keep their structure but their BODIES
+            # are inlined too (scan bodies otherwise retain jit/custom_jvp
+            # eqns whose params — e.g. ctx_mesh — block serialization).
+            if name in ("scan", "while", "cond"):
+                changed_params = {}
+                for key, val in eqn.params.items():
+                    if hasattr(val, "jaxpr") and hasattr(val, "consts"):
+                        inner = inline_calls(val.jaxpr, max_depth - 1)
+                        if inner is not val.jaxpr:
+                            changed_params[key] = type(val)(inner, val.consts)
+                    elif key == "branches" and isinstance(val, (tuple, list)):
+                        new_branches = []
+                        any_b = False
+                        for b in val:
+                            inner = inline_calls(b.jaxpr, max_depth - 1)
+                            any_b = any_b or inner is not b.jaxpr
+                            new_branches.append(type(b)(inner, b.consts))
+                        if any_b:
+                            changed_params[key] = tuple(new_branches)
+                if changed_params:
+                    changed = True
+                    params = dict(eqn.params)
+                    params.update(changed_params)
+                    new_eqns.append(eqn.replace(invars=new_invars,
+                                                params=params))
+                    continue
             new_eqns.append(eqn.replace(invars=new_invars))
 
     if not changed:

@@ -145,6 +145,11 @@ def encode_value(v: Any) -> Any:
         return {"t": "jaxpr", "v": _encode_jaxpr(v)}
     if v is jax.dtypes.float0:
         return {"t": "float0"}
+    if type(v).__name__ == "UnspecifiedValue":  # jax sharding sentinel
+        return {"t": "unspecified"}
+    if (type(v).__name__ in ("Mesh", "AbstractMesh")
+            and not getattr(v, "axis_names", None)):
+        return {"t": "empty_mesh"}  # trace-context mesh placeholder
     raise TypeError(
         f"cannot serialize param value of type {type(v).__name__}: {v!r}")
 
@@ -176,6 +181,12 @@ def decode_value(v: Any) -> Any:
         return _decode_jaxpr_struct(v["v"])
     if t == "float0":
         return jax.dtypes.float0
+    if t == "unspecified":
+        from jax._src.sharding_impls import UNSPECIFIED
+        return UNSPECIFIED
+    if t == "empty_mesh":
+        from jax.sharding import AbstractMesh
+        return AbstractMesh((), ())
     raise TypeError(f"unknown tag {t}")
 
 

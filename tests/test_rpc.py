@@ -297,3 +297,24 @@ def test_debug_plan_dump(tmp_path):
     finally:
         del os.environ["TEPDIST_DUMP_DIR"]
         ServiceEnv.reset()
+
+
+def test_compile_training_remote_ga(server):
+    """Session-level loss+optimizer API with remote GA: matches a local
+    plan_training trajectory."""
+    import optax
+    from tepdist_tpu.train import plan_training
+
+    port, _ = server
+    loss_fn, _, params, _, x, y = _mlp_setup(batch=32)
+    tx = optax.adam(1e-2)
+
+    sess = TepdistSession(f"127.0.0.1:{port}", mesh_axes=[("data", 4)])
+    sess.compile_training(loss_fn, tx, params, x, y, num_micro_batches=2)
+    remote = [sess.run(x, y) for _ in range(3)]
+    sess.close()
+
+    local = plan_training(loss_fn, tx, params, x, y, num_micro_batches=2,
+                          topology=None, explore=False)
+    expected = [local.step(x, y) for _ in range(3)]
+    np.testing.assert_allclose(remote, expected, rtol=1e-4)
