@@ -1,3 +1,3 @@
-from tepdist_tpu.models import gpt2, gpt_moe, mlp, wide_resnet
+from tepdist_tpu.models import gpt2, gpt_moe, llama, mlp, wide_resnet
 
-__all__ = ["gpt2", "gpt_moe", "mlp", "wide_resnet"]
+__all__ = ["gpt2", "gpt_moe", "llama", "mlp", "wide_resnet"]

@@ -144,3 +144,45 @@ def test_annotation_builder(devices):
     l_ref, _ = fn(params, x, y)
     l, _ = plan.step(params, x, y)
     np.testing.assert_allclose(np.asarray(l), np.asarray(l_ref), rtol=1e-4)
+
+
+def test_planner_fuzz_random_mlps(devices):
+    """Fuzz: random small architectures auto-planned on random meshes must
+    reproduce unsharded numerics exactly."""
+    import random
+
+    rng = random.Random(7)
+    for trial in range(5):
+        depth = rng.randint(1, 3)
+        dims = [rng.choice([16, 32, 64]) for _ in range(depth + 1)]
+        batch = rng.choice([16, 32, 64])
+        act = rng.choice([jax.nn.relu, jnp.tanh, jax.nn.gelu])
+
+        def loss_fn(params, x, y, act=act, depth=depth):
+            h = x
+            for i in range(depth):
+                h = act(h @ params[f"w{i}"])
+            return jnp.mean((h - y) ** 2)
+
+        k = jax.random.PRNGKey(trial)
+        keys = jax.random.split(k, depth + 2)
+        params = {f"w{i}": jax.random.normal(keys[i], (dims[i], dims[i + 1]))
+                  * 0.3 for i in range(depth)}
+        x = jax.random.normal(keys[-2], (batch, dims[0]))
+        y = jax.random.normal(keys[-1], (batch, dims[depth]))
+        topo = rng.choice([
+            MeshTopology([("data", 8)]),
+            MeshTopology([("data", 2), ("model", 4)]),
+            MeshTopology([("model", 8)]),
+        ])
+        fn = jax.value_and_grad(loss_fn)
+        plan = auto_parallel(fn, topo, params, x, y)
+        l_ref, g_ref = fn(params, x, y)
+        l, g = plan.step(params, x, y)
+        np.testing.assert_allclose(np.asarray(l), np.asarray(l_ref),
+                                   rtol=1e-4,
+                                   err_msg=f"trial {trial} {topo}")
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
+            g, g_ref)
