@@ -278,3 +278,26 @@ def test_llama_trains_and_plans(devices):
                          MeshTopology([("data", 8)]), params, tokens)
     l_plan, _ = plan.step(params, tokens)
     np.testing.assert_allclose(float(l_plan), loss0, rtol=1e-4)
+
+
+def test_llama_model_axis_plan(devices):
+    """Llama on a model axis: whatever the planner picks (TP or replication
+    around the GQA repeat), numerics must be exact."""
+    from tepdist_tpu.models import llama
+
+    cfg = llama.CONFIGS["test"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(1))
+    tokens = llama.fake_batch(cfg, 4, 32)
+
+    def loss(p, t):
+        return llama.loss_fn(p, t, cfg)
+
+    plan = auto_parallel(jax.value_and_grad(loss),
+                         MeshTopology([("model", 4)]), params, tokens)
+    l_ref, g_ref = jax.value_and_grad(loss)(params, tokens)
+    l, g = plan.step(params, tokens)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l_ref), rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
+        g, g_ref)
