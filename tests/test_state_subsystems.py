@@ -174,3 +174,28 @@ def test_affinity_groups_adam_slots():
     g_w1 = [g for g in groups
             if any(graph.invars[i].aval.shape == (8, 16) for i in g)]
     assert g_w1 and len(g_w1[0]) >= 3  # param + m + v
+
+
+def test_distributed_buffer_addressable_shards(devices):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(devices[:4]), ("x",))
+    buf = DistributedBuffer.from_host(
+        np.arange(16, dtype=np.float32).reshape(4, 4),
+        sharding=NamedSharding(mesh, P("x")))
+    shards = buf.addressable_shards()
+    assert len(shards) == 4
+    for s in shards:
+        assert np.asarray(s.data).shape == (1, 4)
+    assert "host+device" in repr(buf)
+
+
+def test_variable_specs_devices_holding():
+    topo = MeshTopology([("data", 2), ("model", 2)])
+    mgr = VariableSpecsMgr(topo)
+    ts = TensorStrategy({"data": DimStrategy.split_on(0, 2),
+                         "model": DimStrategy.split_on(1, 2)})
+    mgr.derive(7, (8, 8), "float32", ts)
+    assert mgr.devices_holding(7) == [0, 1, 2, 3]
+    # Fully sharded: every device holds a distinct slice.
+    assert mgr.unique_slice_devices(7) == [0, 1, 2, 3]
