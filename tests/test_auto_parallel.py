@@ -186,3 +186,27 @@ def test_planner_fuzz_random_mlps(devices):
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
             g, g_ref)
+
+
+def test_single_device_topology_degenerates_cleanly(devices):
+    """A 1-device 'mesh' must plan and run (the single-chip path bench
+    uses) — everything replicated, no constraints, exact numerics."""
+    fn, params, x, y = _mlp()
+    plan = auto_parallel(fn, MeshTopology([("data", 1)]), params, x, y)
+    assert plan.sharding_plan.constraints == {}
+    l_ref, _ = fn(params, x, y)
+    l, _ = plan.step(params, x, y)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l_ref), rtol=1e-5)
+
+
+def test_shared_time_only_topology(devices):
+    """A topology whose only device axis is trivial (all ordinals shared or
+    size-1) still produces a runnable plan."""
+    fn, params, x, y = _mlp()
+    topo = MeshTopology([("micro", 4), ("data", 1)],
+                        share_dev_flags=[True, False])
+    assert topo.num_devices == 1
+    plan = auto_parallel(fn, topo, params, x, y)
+    l_ref, _ = fn(params, x, y)
+    l, _ = plan.step(params, x, y)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l_ref), rtol=1e-5)
