@@ -35,14 +35,21 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
         if not os.path.exists(_SO) or (
                 os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            # Per-process tmp name: concurrent importing processes must not
+            # compile onto the same file (the lock above is per-process only).
+            tmp = f"{_SO}.tmp.{os.getpid()}"
             try:
                 subprocess.run(
                     ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                     _SRC, "-o", _SO + ".tmp"],
+                     _SRC, "-o", tmp],
                     check=True, capture_output=True, timeout=120)
-                os.replace(_SO + ".tmp", _SO)
+                os.replace(tmp, _SO)
             except Exception as e:  # noqa: BLE001 — fallback to Python
                 log.warning("native scheduler build failed: %s", e)
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
                 _build_failed = True
                 return None
         try:

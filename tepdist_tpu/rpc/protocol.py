@@ -71,19 +71,26 @@ def pack(header: Dict[str, Any], blobs: List[bytes] = ()) -> bytes:
 
 
 def unpack(data: bytes) -> Tuple[Dict[str, Any], List[bytes]]:
-    if data[:4] != _MAGIC:
+    total = len(data)
+    if total < 12 or data[:4] != _MAGIC:
         raise ValueError("bad envelope magic")
     off = 4
     (hlen,) = struct.unpack_from("<I", data, off)
     off += 4
+    if off + hlen + 4 > total:
+        raise ValueError("truncated envelope (header)")
     header = json.loads(data[off:off + hlen].decode())
     off += hlen
     (n,) = struct.unpack_from("<I", data, off)
     off += 4
     blobs = []
-    for _ in range(n):
+    for i in range(n):
+        if off + 8 > total:
+            raise ValueError(f"truncated envelope (blob {i} length)")
         (blen,) = struct.unpack_from("<Q", data, off)
         off += 8
+        if off + blen > total:
+            raise ValueError(f"truncated envelope (blob {i} payload)")
         blobs.append(data[off:off + blen])
         off += blen
     return header, blobs

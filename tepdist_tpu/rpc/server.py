@@ -273,7 +273,27 @@ class TepdistServicer:
                     val = self._place(val, plan.shardings[i])
                 args.append(val)
         with self._exec_lock:
-            outs = plan.step_fn(*args)
+            try:
+                outs = plan.step_fn(*args)
+            except Exception:
+                # step_fn donates aliased variable buffers; a failure after
+                # dispatch leaves the store referencing deleted arrays.
+                # Invalidate those entries so later steps get a clear
+                # "re-transfer or DoRemoteRestore" error instead of an
+                # opaque deleted-buffer crash.
+                with self._lock:
+                    dropped = []
+                    for ii in set(plan.state_alias.values()):
+                        v = self.variables.get(ii)
+                        if isinstance(v, jax.Array) and v.is_deleted():
+                            del self.variables[ii]
+                            dropped.append(ii)
+                if dropped:
+                    log.error(
+                        "ExecutePlan failed after buffer donation; variables "
+                        "%s invalidated — re-transfer them or DoRemoteRestore "
+                        "before the next step", sorted(dropped))
+                raise
             # Write aliased state back into the variable store (server-held).
             with self._lock:
                 for oi, ii in plan.state_alias.items():
@@ -400,18 +420,23 @@ class TepdistServicer:
     def _do_save(self, opts) -> None:
         from tepdist_tpu.runtime.checkpoint import CheckpointUtil
         with self._lock:
-            data = {str(k): np.asarray(jax.device_get(v))
-                    for k, v in self.variables.items()}
+            # Values pass through as-is: CheckpointUtil writes only this
+            # host's addressable shards for non-fully-addressable arrays
+            # (reference: per-worker slice saves, not a full gather).
+            data = {str(k): v for k, v in self.variables.items()}
             # Worker-side optimizer slots (adam moments etc.) are part of
             # the recoverable state.
             if self.worker_plan is not None:
                 for stage, slots in getattr(self.worker_plan, "opt_states",
                                             {}).items():
                     for j, slot in enumerate(slots):
-                        data[f"opt:{stage}:{j}"] = np.asarray(
-                            jax.device_get(slot))
+                        data[f"opt:{stage}:{j}"] = slot
+            # Worker 0 owns the manifest/prune queue; other workers write
+            # shard files only (DoRemoteSave fans out from the master, so
+            # worker 0 always records the step).
             CheckpointUtil(self.ckpt_dir,
-                           max_to_keep=opts.get("max_to_keep", 5)).save(
+                           max_to_keep=opts.get("max_to_keep", 5),
+                           own_manifest=(self.task_index == 0)).save(
                 opts.get("global_step", self.global_step), data,
                 worker_id=self.task_index)
 

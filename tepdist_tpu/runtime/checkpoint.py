@@ -6,32 +6,96 @@ variable slice maps, temp-file shards merged, ``max_to_keep`` prefix queue
 persisted, lazy restore latched and consumed on the next ExecutePlan.
 
 TPU-native mechanics: variables are jax Arrays whose sharding already
-describes the per-device slices, so each host saves the addressable shards
-of its arrays (`.addressable_shards`); restore re-places the assembled
-array with ``device_put`` under the original sharding. Storage is npz per
-step + a JSON manifest holding the keep-queue (the reference's persisted
-prefix queue)."""
+describes the per-device slices, so each host saves only its *addressable
+shards* (`.addressable_shards`) together with each shard's global index
+(the reference's ``VariableSpec.start_offset_pairs_map``); restore
+reassembles the full array from every worker's shard files and re-places
+it with ``device_put`` under the original sharding. Storage is npz per
+step (+ a JSON sidecar with shard indices) and a JSON manifest holding
+the keep-queue (the reference's persisted prefix queue). The manifest is
+owned by worker 0 and guarded by an fcntl lock file so concurrent
+same-directory writers cannot lose queue entries."""
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import json
 import os
 import shutil
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
 
 import numpy as np
 
 
+def _atomic_write(path: str, write_fn: Callable[[str], None]) -> None:
+    """Write via a per-process tmp name + os.replace; never leaves a partial
+    file at ``path`` and cleans the tmp on failure."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        write_fn(tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def _shard_entries(name: str, value) -> Tuple[Dict[str, np.ndarray],
+                                              Dict[str, Any]]:
+    """Flatten one (possibly multi-host sharded) array into npz entries.
+
+    Fully-addressable values are stored whole under ``name``. For a
+    non-fully-addressable jax Array (multi-controller mode), each locally
+    addressable shard becomes ``name::shardK`` plus sidecar metadata
+    recording its global index, so the union of all workers' files covers
+    the array exactly (reference: per-worker BundleWriter slices)."""
+    import jax
+
+    if not isinstance(value, jax.Array) or value.is_fully_addressable:
+        return {name: np.asarray(jax.device_get(value))}, {}
+    arrays: Dict[str, np.ndarray] = {}
+    meta: Dict[str, Any] = {}
+    seen = set()
+    for k, sh in enumerate(value.addressable_shards):
+        bounds = tuple(sl.indices(dim)[:2]
+                       for sl, dim in zip(sh.index, value.shape))
+        if bounds in seen:      # replicated shard: one copy is enough
+            continue
+        seen.add(bounds)
+        key = f"{name}::shard{k}"
+        arrays[key] = np.asarray(sh.data)
+        meta[key] = {"of": name, "index": [list(b) for b in bounds],
+                     "global_shape": list(value.shape)}
+    return arrays, meta
+
+
 class CheckpointUtil:
-    def __init__(self, directory: str, max_to_keep: int = 5):
+    def __init__(self, directory: str, max_to_keep: int = 5,
+                 own_manifest: bool = True):
+        """``own_manifest=False`` makes this writer shard-only: it never
+        touches the keep-queue or prunes (non-zero workers)."""
         self.dir = directory
         self.max_to_keep = max_to_keep
+        self.own_manifest = own_manifest
         os.makedirs(directory, exist_ok=True)
 
     @property
     def _manifest_path(self) -> str:
         return os.path.join(self.dir, "manifest.json")
+
+    @contextlib.contextmanager
+    def _manifest_lock(self):
+        path = os.path.join(self.dir, ".manifest.lock")
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
 
     def _load_manifest(self) -> Dict[str, Any]:
         try:
@@ -41,45 +105,62 @@ class CheckpointUtil:
             return {"steps": []}
 
     def _store_manifest(self, m: Dict[str, Any]) -> None:
-        tmp = self._manifest_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(m, f)
-        os.replace(tmp, self._manifest_path)
+        def write(tmp):
+            with open(tmp, "w") as f:
+                json.dump(m, f)
+        _atomic_write(self._manifest_path, write)
 
     # ------------------------------------------------------------------
-    def save(self, step: int, variables: Dict[str, np.ndarray],
+    def save(self, step: int, variables: Dict[str, Any],
              worker_id: int = 0) -> str:
         """Write one step's variables; prune beyond max_to_keep (the
-        reference's prefix queue semantics, incl. persistence)."""
+        reference's prefix queue semantics, incl. persistence).
+
+        Values may be numpy arrays or jax Arrays; non-fully-addressable
+        jax Arrays are written as this host's shards only."""
         step_dir = os.path.join(self.dir, f"step_{step:012d}")
         os.makedirs(step_dir, exist_ok=True)
-        arrays = {}
+        arrays: Dict[str, np.ndarray] = {}
+        shard_meta: Dict[str, Any] = {}
         for k, v in variables.items():
-            arr = np.asarray(v)
-            if arr.dtype.name == "bfloat16":  # npz has no bf16: store bits
-                arrays[f"{k}::bfloat16"] = arr.view(np.uint16)
-            else:
-                arrays[k] = arr
+            entries, meta = _shard_entries(k, v)
+            shard_meta.update(meta)
+            for ek, arr in entries.items():
+                if arr.dtype.name == "bfloat16":  # npz has no bf16: store bits
+                    arrays[f"{ek}::bfloat16"] = arr.view(np.uint16)
+                else:
+                    arrays[ek] = arr
         final = os.path.join(step_dir, f"worker{worker_id}.npz")
-        tmp = final + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **arrays)
-        os.replace(tmp, final)
-        m = self._load_manifest()
-        if step not in m["steps"]:
-            m["steps"].append(step)
-            m["steps"].sort()
-        while len(m["steps"]) > self.max_to_keep:
-            old = m["steps"].pop(0)
-            shutil.rmtree(os.path.join(self.dir, f"step_{old:012d}"),
-                          ignore_errors=True)
-        m["last_saved"] = time.time()
-        self._store_manifest(m)
+        if shard_meta:
+            # Meta first: an npz with ::shard keys but no sidecar would be
+            # silently skipped by restore's assembly.
+            mpath = os.path.join(step_dir, f"worker{worker_id}.meta.json")
+
+            def write_meta(tmp):
+                with open(tmp, "w") as f:
+                    json.dump(shard_meta, f)
+            _atomic_write(mpath, write_meta)
+
+        def write_npz(tmp):
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+        _atomic_write(final, write_npz)
+        if self.own_manifest:
+            with self._manifest_lock():
+                m = self._load_manifest()
+                if step not in m["steps"]:
+                    m["steps"].append(step)
+                    m["steps"].sort()
+                while len(m["steps"]) > self.max_to_keep:
+                    old = m["steps"].pop(0)
+                    shutil.rmtree(os.path.join(self.dir, f"step_{old:012d}"),
+                                  ignore_errors=True)
+                m["last_saved"] = time.time()
+                self._store_manifest(m)
         return final
 
     # ------------------------------------------------------------------
-    def restore(self, step: int = -1, worker_id: int = 0
-                ) -> Tuple[Dict[str, np.ndarray], int]:
+    def _resolve_step(self, step: int) -> int:
         m = self._load_manifest()
         if not m["steps"]:
             raise FileNotFoundError(f"no checkpoints in {self.dir}")
@@ -87,8 +168,10 @@ class CheckpointUtil:
             step = m["steps"][-1]
         if step not in m["steps"]:
             raise FileNotFoundError(f"step {step} not in {m['steps']}")
-        path = os.path.join(self.dir, f"step_{step:012d}",
-                            f"worker{worker_id}.npz")
+        return step
+
+    @staticmethod
+    def _load_npz(path: str) -> Dict[str, np.ndarray]:
         loaded = np.load(path)
         out: Dict[str, np.ndarray] = {}
         for k in loaded.files:
@@ -97,7 +180,67 @@ class CheckpointUtil:
                 out[k[:-10]] = loaded[k].view(ml_dtypes.bfloat16)
             else:
                 out[k] = loaded[k]
+        return out
+
+    def restore(self, step: int = -1, worker_id: int = 0
+                ) -> Tuple[Dict[str, np.ndarray], int]:
+        """Read back this worker's variables; shard entries (written in
+        multi-controller mode) are assembled to full arrays from every
+        worker's files in the step directory."""
+        step = self._resolve_step(step)
+        step_dir = os.path.join(self.dir, f"step_{step:012d}")
+        local = f"worker{worker_id}.npz"
+        data = self._load_npz(os.path.join(step_dir, local))
+        sharded = {k for k in data if "::shard" in k}
+        if not sharded:
+            return data, step
+        out = {k: v for k, v in data.items() if "::shard" not in k}
+        out.update(self._assemble_shards(step_dir, preloaded={local: data}))
         return out, step
+
+    def _assemble_shards(self, step_dir: str,
+                         preloaded: Optional[Dict[str, Dict[str, np.ndarray]]]
+                         = None) -> Dict[str, np.ndarray]:
+        """Merge every worker's shard files into full arrays (reference:
+        MergeShardedTempFiles). Coverage is checked by counting deduped
+        shard extents against the global element count — NamedSharding
+        shards are disjoint-or-identical, so exact-bounds dedup suffices
+        (no per-element mask)."""
+        preloaded = preloaded or {}
+        full: Dict[str, np.ndarray] = {}
+        covered: Dict[str, set] = {}
+        for fn in sorted(os.listdir(step_dir)):
+            if not (fn.startswith("worker") and fn.endswith(".npz")):
+                continue
+            mpath = os.path.join(step_dir, fn[:-4] + ".meta.json")
+            if not os.path.exists(mpath):
+                continue
+            with open(mpath) as f:
+                meta = json.load(f)
+            data = (preloaded[fn] if fn in preloaded
+                    else self._load_npz(os.path.join(step_dir, fn)))
+            for key, m in meta.items():
+                if key not in data:
+                    continue
+                name = m["of"]
+                bounds = tuple((a, b) for a, b in m["index"])
+                if name not in full:
+                    full[name] = np.zeros(m["global_shape"],
+                                          dtype=data[key].dtype)
+                    covered[name] = set()
+                if bounds in covered[name]:
+                    continue
+                covered[name].add(bounds)
+                idx = tuple(slice(a, b) for a, b in bounds)
+                full[name][idx] = data[key]
+        for name, arr in full.items():
+            n = sum(int(np.prod([b - a for a, b in bs]))
+                    for bs in covered[name])
+            if n != arr.size:
+                raise ValueError(
+                    f"checkpoint shard coverage incomplete for '{name}' "
+                    f"({n}/{arr.size} elements)")
+        return full
 
     def steps(self) -> List[int]:
         return list(self._load_manifest()["steps"])
@@ -105,15 +248,17 @@ class CheckpointUtil:
 
 def save_sharded(directory: str, step: int, tree, max_to_keep: int = 5):
     """Save a pytree of (possibly sharded) jax Arrays: each host writes only
-    its addressable shards (reference: per-worker BundleWriter temp files)."""
+    its addressable shards (reference: per-worker BundleWriter temp files);
+    worker 0 owns the manifest/prune queue."""
     import jax
 
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    util = CheckpointUtil(directory, max_to_keep)
-    flat = {str(i): np.asarray(jax.device_get(l)) for i, l in enumerate(leaves)}
-    util.save(step, flat)
-    with open(os.path.join(directory, "treedef.json"), "w") as f:
-        json.dump({"n": len(leaves)}, f)
+    pid = jax.process_index()
+    util = CheckpointUtil(directory, max_to_keep, own_manifest=(pid == 0))
+    util.save(step, {str(i): l for i, l in enumerate(leaves)}, worker_id=pid)
+    if pid == 0:
+        with open(os.path.join(directory, "treedef.json"), "w") as f:
+            json.dump({"n": len(leaves)}, f)
     return treedef
 
 
@@ -121,7 +266,7 @@ def restore_sharded(directory: str, treedef, step: int = -1, shardings=None):
     import jax
 
     util = CheckpointUtil(directory)
-    data, step = util.restore(step)
+    data, step = util.restore(step, worker_id=jax.process_index())
     leaves = [data[str(i)] for i in range(len(data))]
     if shardings is not None:
         leaves = [jax.device_put(l, s) for l, s in zip(leaves, shardings)]

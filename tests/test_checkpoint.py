@@ -1,0 +1,105 @@
+"""Checkpoint subsystem tests (reference: distributed_checkpoint_utils —
+per-worker slice saves merged on restore, persisted keep-queue)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tepdist_tpu.runtime.checkpoint import (
+    CheckpointUtil,
+    restore_sharded,
+    save_sharded,
+)
+
+
+def test_round_trip_with_bf16(tmp_path):
+    util = CheckpointUtil(str(tmp_path))
+    data = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.ones(5, dtype=jnp.bfloat16)}
+    util.save(3, data)
+    out, step = util.restore()
+    assert step == 3
+    np.testing.assert_array_equal(out["w"], data["w"])
+    assert out["b"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(out["b"], np.float32),
+                                  np.ones(5, np.float32))
+
+
+def test_keep_queue_prunes(tmp_path):
+    util = CheckpointUtil(str(tmp_path), max_to_keep=2)
+    for s in (1, 2, 3):
+        util.save(s, {"x": np.array([s])})
+    assert util.steps() == [2, 3]
+    assert not (tmp_path / "step_000000000001").exists()
+    with pytest.raises(FileNotFoundError):
+        util.restore(1)
+
+
+def test_shard_only_writer_leaves_manifest_alone(tmp_path):
+    """own_manifest=False (non-zero workers) must not create or mutate the
+    keep-queue — worker 0 owns pruning (ADVICE r1: manifest races)."""
+    w1 = CheckpointUtil(str(tmp_path), own_manifest=False)
+    w1.save(7, {"x": np.array([1.0])}, worker_id=1)
+    assert not (tmp_path / "manifest.json").exists()
+    w0 = CheckpointUtil(str(tmp_path), own_manifest=True)
+    w0.save(7, {"x": np.array([2.0])}, worker_id=0)
+    assert w0.steps() == [7]
+    # Both workers' files live in the same step dir.
+    step_dir = tmp_path / "step_000000000007"
+    assert (step_dir / "worker0.npz").exists()
+    assert (step_dir / "worker1.npz").exists()
+
+
+def test_shard_assembly_across_workers(tmp_path):
+    """Restore assembles a full array from per-worker shard files + index
+    sidecars — the multi-controller save format (reference:
+    MergeShardedTempFiles + VariableSpec offset maps)."""
+    full = np.arange(32, dtype=np.float32).reshape(8, 4)
+    util = CheckpointUtil(str(tmp_path))
+    # Worker 0 writes rows 0:4 (plus manifest), worker 1 writes rows 4:8 —
+    # the exact files CheckpointUtil.save emits in multi-controller mode.
+    util.save(5, {})   # manifest entry + step dir
+    step_dir = tmp_path / "step_000000000005"
+    for w, (lo, hi) in enumerate([(0, 4), (4, 8)]):
+        np.savez(step_dir / f"worker{w}.npz",
+                 **{f"0::shard0": full[lo:hi]})
+        with open(step_dir / f"worker{w}.meta.json", "w") as f:
+            json.dump({"0::shard0": {
+                "of": "0", "index": [[lo, hi], [0, 4]],
+                "global_shape": [8, 4]}}, f)
+    out, step = util.restore(worker_id=0)
+    assert step == 5
+    np.testing.assert_array_equal(out["0"], full)
+
+
+def test_shard_assembly_incomplete_coverage_raises(tmp_path):
+    util = CheckpointUtil(str(tmp_path))
+    util.save(1, {})
+    step_dir = tmp_path / "step_000000000001"
+    np.savez(step_dir / "worker0.npz",
+             **{"0::shard0": np.zeros((2, 4), np.float32)})
+    with open(step_dir / "worker0.meta.json", "w") as f:
+        json.dump({"0::shard0": {"of": "0", "index": [[0, 2], [0, 4]],
+                                 "global_shape": [8, 4]}}, f)
+    with pytest.raises(ValueError, match="coverage incomplete"):
+        util.restore(worker_id=0)
+
+
+def test_save_sharded_pytree_round_trip(tmp_path, devices):
+    """Pytree save/restore through the jax-Array path, including a mesh-
+    sharded leaf (single-controller: fully addressable, stored whole)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(devices[:4]).reshape(4), axis_names=("data",))
+    x = jax.device_put(jnp.arange(16.0).reshape(4, 4),
+                       NamedSharding(mesh, P("data", None)))
+    tree = {"a": x, "b": jnp.float32(3.5)}
+    treedef = save_sharded(str(tmp_path), 11, tree)
+    restored, step = restore_sharded(str(tmp_path), treedef)
+    assert step == 11
+    np.testing.assert_array_equal(np.asarray(restored["a"]), np.asarray(x))
+    assert float(restored["b"]) == 3.5

@@ -65,3 +65,15 @@ def test_service_env_config_file(tmp_path, monkeypatch):
         monkeypatch.delenv("NUM_STAGES", raising=False)
         monkeypatch.delenv("TEPDIST_CONFIG", raising=False)
         ServiceEnv.reset()
+
+
+def test_envelope_truncation_detected():
+    """Corrupt/short envelopes raise ValueError at the decode site, not a
+    confusing downstream np.frombuffer failure (ADVICE r1)."""
+    msg = protocol.pack({"a": 1}, [b"x" * 100, b"y" * 50])
+    for cut in (8, 20, len(msg) - 60, len(msg) - 1):
+        with pytest.raises(ValueError):
+            protocol.unpack(msg[:cut])
+    # Untruncated still parses.
+    header, blobs = protocol.unpack(msg)
+    assert header == {"a": 1} and len(blobs) == 2

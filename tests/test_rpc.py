@@ -318,3 +318,28 @@ def test_compile_training_remote_ga(server):
                           topology=None, explore=False)
     expected = [local.step(x, y) for _ in range(3)]
     np.testing.assert_allclose(remote, expected, rtol=1e-4)
+
+
+def test_execute_plan_failure_invalidates_donated_vars():
+    """If step_fn fails after donating aliased variable buffers, the store
+    entries pointing at deleted arrays are invalidated with a clear error
+    path instead of poisoning every later step (ADVICE r1)."""
+    from tepdist_tpu.rpc import protocol
+    from tepdist_tpu.rpc.server import TepdistServicer, _CompiledPlan
+
+    servicer = TepdistServicer(devices=jax.devices()[:1])
+    v = jnp.arange(4.0)
+    servicer.variables[0] = v
+
+    def exploding_step(*args):
+        args[0].delete()          # simulate donation consuming the buffer
+        raise RuntimeError("boom after dispatch")
+
+    plan = _CompiledPlan(exploding_step, in_specs=None, topology=None,
+                         var_arg_indices={0}, state_alias={0: 0},
+                         out_is_state={0: 0}, n_invars=1,
+                         strategies_summary={}, shardings=None)
+    handle = servicer.plan_cache.insert(plan)
+    with pytest.raises(RuntimeError, match="boom"):
+        servicer.ExecutePlan(protocol.pack({"handle": handle}))
+    assert 0 not in servicer.variables   # invalidated, not dangling
