@@ -35,7 +35,8 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
     ("NUM_STAGES", int, -1, "fixed pipeline stage count (config mode)"),
     ("MICRO_NUM_LIMIT", int, 2, "max in-flight micro-batches (1F1B window)"),
     ("GROUP_SCHED_COUNT", int, 3, "candidate schedules tried by TaskScheduler"),
-    ("PP_BANDWIDTH", float, 16.0, "pipeline xfer bandwidth GB/s (DCN override)"),
+    ("PP_BANDWIDTH", float, 0.0, "pipeline xfer bandwidth GB/s override "
+     "(0 = auto: ICI intra-worker, DCN cross-worker; reference fixed 16)"),
     ("ILP_TIME_LIMIT", float, 5.0, "ILP solver time limit (s)"),
     ("ILP_NUM_THREADS", int, 0, "compat: scipy/HiGHS milp is single-threaded"),
     ("FAKE_INPUT", bool, False, "reuse first batch forever (benchmark mode)"),

@@ -27,7 +27,14 @@ class GPT2Config:
     n_layer: int = 12
     n_head: int = 12
     dtype: Any = jnp.bfloat16
-    # Reference config names (examples/GPT2/{117M,345M,1.5B,175B}.json).
+    # "einsum" (planner-visible dots) or "flash" (pallas fused kernel with
+    # custom VJP — O(T) activation memory, the training default on TPU for
+    # larger configs). Reference config names mirror
+    # examples/GPT2/{117M,345M,1.5B,175B}.json.
+    attn: str = "einsum"
+    # Rematerialise each transformer block in backward (jax.checkpoint):
+    # trades recompute FLOPs for activation HBM — how the big configs fit.
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -105,6 +112,9 @@ def attention(block, x, cfg: GPT2Config, attn_impl=None):
     q = q.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
     k = k.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+    if attn_impl is None and cfg.attn == "flash":
+        from tepdist_tpu.ops.pallas.flash_attention import flash_attention
+        attn_impl = flash_attention
     if attn_impl is not None:
         o = attn_impl(q, k, v)
     else:
@@ -136,8 +146,15 @@ def forward(params, tokens, cfg: GPT2Config, attn_impl=None):
     B, T = tokens.shape
     x = params["wte"][tokens] + params["wpe"][:T]
     x = x.astype(cfg.dtype)
-    for i in range(cfg.n_layer):
-        x = transformer_block(params[f"h{i}"], x, cfg, attn_impl)
+    block_fn = transformer_block
+    if cfg.remat:
+        block_fn = jax.checkpoint(
+            lambda blk, h: transformer_block(blk, h, cfg, attn_impl))
+        for i in range(cfg.n_layer):
+            x = block_fn(params[f"h{i}"], x)
+    else:
+        for i in range(cfg.n_layer):
+            x = block_fn(params[f"h{i}"], x, cfg, attn_impl)
     x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
     return (x @ params["wte"].T).astype(jnp.float32)
 
@@ -145,6 +162,46 @@ def forward(params, tokens, cfg: GPT2Config, attn_impl=None):
 def loss_fn(params, tokens, cfg: GPT2Config, attn_impl=None):
     """Next-token cross entropy over shifted tokens (reference GPT2 LM loss)."""
     logits = forward(params, tokens[:, :-1], cfg, attn_impl)
+    targets = tokens[:, 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+# --------------------------------------------------------------------------
+# Scan-over-layers form: per-layer params stacked on a leading [L, ...] dim
+# and the block applied with lax.scan — one layer's HLO traced once instead
+# of n_layer times (compile time and program size drop ~n_layer-fold; the
+# math is identical). This is the TPU-idiomatic big-model form.
+# --------------------------------------------------------------------------
+
+def stacked_init_params(cfg: GPT2Config, key):
+    """init_params in stacked form: {embed leaves, "blocks": {k: [L, ...]}}."""
+    params = init_params(cfg, key)
+    out = {k: params[k] for k in ("wte", "wpe", "ln_f_g", "ln_f_b")}
+    out["blocks"] = stack_block_params(params, cfg)
+    return out
+
+
+def forward_stacked(params, tokens, cfg: GPT2Config, attn_impl=None):
+    """tokens: int32 [B, T] -> logits [B, T, vocab] (fp32), scanning the
+    stacked block params."""
+    B, T = tokens.shape
+    x = params["wte"][tokens] + params["wpe"][:T]
+    x = x.astype(cfg.dtype)
+
+    def body(h, layer_params):
+        return transformer_block(layer_params, h, cfg, attn_impl), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
+    return (x @ params["wte"].T).astype(jnp.float32)
+
+
+def loss_fn_stacked(params, tokens, cfg: GPT2Config, attn_impl=None):
+    logits = forward_stacked(params, tokens[:, :-1], cfg, attn_impl)
     targets = tokens[:, 1:]
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]

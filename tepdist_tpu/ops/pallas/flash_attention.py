@@ -1,13 +1,16 @@
-"""Pallas TPU flash attention kernel.
+"""Pallas TPU flash attention kernel (forward + backward).
 
 The intra-device hot op: online-softmax blockwise attention computed in VMEM
 (one pass over K/V blocks per Q block), MXU-shaped [block, head_dim] matmuls,
-fp32 accumulators. Usable standalone, as the ``inner`` of Ulysses sequence
-parallelism, or as the per-block compute of ring attention.
+fp32 accumulators. Training-ready via ``jax.custom_vjp``: the forward saves
+(O, LSE) residuals and the backward recomputes P blockwise — two kernels,
+one accumulating dQ over K blocks, one accumulating dK/dV over Q blocks —
+so no [T, T] matrix is ever materialised in HBM in either direction.
 
-Runs in interpret mode off-TPU (tests), compiled on TPU. Reference parity:
-none — the reference has no fused attention at all (SURVEY.md §5.7); this is
-TPU-native surplus.
+Usable standalone, as the ``inner`` of Ulysses sequence parallelism, or as
+the per-block compute of ring attention. Runs in interpret mode off-TPU
+(tests), compiled on TPU. Reference parity: none — the reference has no
+fused attention at all (SURVEY.md §5.7); this is TPU-native surplus.
 """
 
 from __future__ import annotations
@@ -23,8 +26,8 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float, q_block: int, seq_len: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                causal: bool, scale: float, q_block: int, seq_len: int):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
     bq, D = q.shape
@@ -64,13 +67,196 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         hi = n_blocks
     m, l, o = jax.lax.fori_loop(0, hi, body, (m0, l0, o0))
     o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               block_k: int, causal: bool, scale: float, q_block: int,
+               seq_len: int):
+    """One Q block: dQ = scale * sum_j dS_j @ K_j, with P recomputed from
+    the saved LSE (no renormalisation pass needed)."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+    do = do_ref[0].astype(jnp.float32)                # [bq, D]
+    lse = lse_ref[0]                                  # [bq, 1]
+    delta = delta_ref[0]                              # [bq, 1]
+    bq, D = q.shape
+    n_blocks = seq_len // block_k
+
+    def body(j, dq):
+        k = k_ref[0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
+        s = q @ k.T                                   # [bq, bk] (pre-scaled)
+        if causal:
+            qpos = qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                          # exact softmax probs
+        dp = do @ v.T                                 # [bq, bk]
+        ds = p * (dp - delta)
+        return dq + ds @ k
+
+    if causal:
+        hi = jnp.minimum(((qi + 1) * q_block + block_k - 1) // block_k,
+                         n_blocks)
+    else:
+        hi = n_blocks
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, D), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float,
+                k_block: int, seq_len: int):
+    """One K/V block: dV = sum_i P_i^T @ dO_i, dK = scale * sum_i dS_i^T @ Q_i."""
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                  # [bk, D]
+    v = v_ref[0].astype(jnp.float32)
+    bk, D = k.shape
+    n_blocks = seq_len // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(i * block_q, block_q)].astype(
+            jnp.float32) * scale                      # [bq, D]
+        do = do_ref[0, pl.dslice(i * block_q, block_q)].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(i * block_q, block_q)]   # [bq, 1]
+        delta = delta_ref[0, pl.dslice(i * block_q, block_q)]
+        s = q @ k.T                                   # [bq, bk]
+        if causal:
+            qpos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            kpos = ki * k_block + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_new = dv + p.T @ do
+        dp = do @ v.T
+        ds = p * (dp - delta)
+        dk_new = dk + ds.T @ q
+        return dk_new, dv_new
+
+    if causal:
+        # Q blocks strictly before this K block contribute nothing.
+        lo = (ki * k_block) // block_q
+    else:
+        lo = 0
+    dk, dv = jax.lax.fori_loop(
+        lo, n_blocks, body,
+        (jnp.zeros((bk, D), jnp.float32), jnp.zeros((bk, D), jnp.float32)))
+    # q was pre-scaled, so dk already carries one factor of scale.
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret):
+    B, H, T, D = q.shape
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, T, D)
+    vf = v.reshape(B * H, T, D)
+    kernel = functools.partial(
+        _fwd_kernel, block_k=block_k, causal=causal, scale=scale,
+        q_block=block_q, seq_len=T)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B * H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return o.reshape(B, H, T, D), lse.reshape(B, H, T)
+
+
+def _bwd_call(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    B, H, T, D = q.shape
+    BH = B * H
+    qf, kf, vf = (x.reshape(BH, T, D) for x in (q, k, v))
+    dof = do.reshape(BH, T, D)
+    lsef = lse.reshape(BH, T, 1)
+    # delta = rowsum(dO * O): cheap elementwise reduce, XLA fuses it.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1).reshape(BH, T, 1)
+
+    full_spec = pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0))
+    row_full = pl.BlockSpec((1, T, 1), lambda b, i: (b, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, causal=causal,
+                          scale=scale, q_block=block_q, seq_len=T),
+        grid=(BH, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            full_spec, full_spec,
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, causal=causal,
+                          scale=scale, k_block=block_k, seq_len=T),
+        grid=(BH, T // block_k),
+        in_specs=[
+            full_spec,
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            full_spec, row_full, row_full,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+    shape = (B, H, T, D)
+    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    return _bwd_call(causal, scale, block_q, block_k, interpret, res, do)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = True,
                     scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
                     interpret: Optional[bool] = None):
-    """q, k, v: [B, H, T, D] -> [B, H, T, D]."""
+    """q, k, v: [B, H, T, D] -> [B, H, T, D]. Differentiable (custom VJP)."""
     B, H, T, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     block_q = min(block_q, T)
@@ -79,24 +265,4 @@ def flash_attention(q, k, v, causal: bool = True,
         raise ValueError(f"seq len {T} must divide blocks {block_q}/{block_k}")
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-
-    qf = q.reshape(B * H, T, D)
-    kf = k.reshape(B * H, T, D)
-    vf = v.reshape(B * H, T, D)
-
-    kernel = functools.partial(
-        _flash_kernel, block_k=block_k, causal=causal, scale=scale,
-        q_block=block_q, seq_len=T)
-    out = pl.pallas_call(
-        kernel,
-        grid=(B * H, T // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-        interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(B, H, T, D)
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)

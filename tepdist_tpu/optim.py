@@ -1,0 +1,80 @@
+"""Memory-lean optimizers for single-chip large-model training.
+
+``adamw_bf16`` stores BOTH Adam moments in bfloat16 (optax's ``mu_dtype``
+only covers the first moment): optimizer state drops from 12 bytes/param
+to 4 bytes/param, which is what lets GPT-2 1.5B train with Adam on one
+16 GB v5e chip. All moment math runs in fp32; only the *storage* is bf16.
+
+Reference parity: the reference's ZeRO-style ``MemSavePlan``
+(cost_spmd_strategy.h:900-911) attacks optimizer memory by sharding state
+across devices; on a single chip the TPU-native lever is storage dtype
+instead. Composes with ``apply_mem_save`` sharding when devices allow.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class AdamBf16State(NamedTuple):
+    count: jnp.ndarray
+    mu: optax.Params
+    nu: optax.Params
+
+
+def scale_by_adam_bf16(b1: float = 0.9, b2: float = 0.95,
+                       eps: float = 1e-8) -> optax.GradientTransformation:
+    """Adam moment tracking with bf16 moment storage, fp32 math."""
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.bfloat16)
+        return AdamBf16State(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree_util.tree_map(zeros, params),
+            nu=jax.tree_util.tree_map(zeros, params))
+
+    def update(grads, state, params=None):
+        del params
+        count = state.count + 1
+        f32 = lambda t: t.astype(jnp.float32)
+
+        def upd_mu(g, m):
+            return b1 * f32(m) + (1 - b1) * f32(g)
+
+        def upd_nu(g, n):
+            return b2 * f32(n) + (1 - b2) * jnp.square(f32(g))
+
+        mu32 = jax.tree_util.tree_map(upd_mu, grads, state.mu)
+        nu32 = jax.tree_util.tree_map(upd_nu, grads, state.nu)
+        c1 = 1 - b1 ** count.astype(jnp.float32)
+        c2 = 1 - b2 ** count.astype(jnp.float32)
+
+        def direction(m, n, g):
+            # Cast straight back to the grad/param dtype: a full fp32
+            # updates tree would cost 4 bytes/param of transient HBM.
+            return ((m / c1) / (jnp.sqrt(n / c2) + eps)).astype(g.dtype)
+
+        updates = jax.tree_util.tree_map(direction, mu32, nu32, grads)
+        bf16 = lambda t: t.astype(jnp.bfloat16)
+        return updates, AdamBf16State(
+            count=count,
+            mu=jax.tree_util.tree_map(bf16, mu32),
+            nu=jax.tree_util.tree_map(bf16, nu32))
+
+    return optax.GradientTransformation(init, update)
+
+
+def adamw_bf16(learning_rate: float, b1: float = 0.9, b2: float = 0.95,
+               eps: float = 1e-8, weight_decay: float = 0.01,
+               mask: Optional[optax.Params] = None
+               ) -> optax.GradientTransformation:
+    """AdamW with bf16 moment storage (4 bytes/param optimizer state)."""
+    return optax.chain(
+        scale_by_adam_bf16(b1=b1, b2=b2, eps=eps),
+        optax.add_decayed_weights(weight_decay, mask=mask),
+        optax.scale(-learning_rate),
+    )

@@ -64,6 +64,9 @@ class ShardingPlan:
     out_specs: List[Optional[PartitionSpec]]   # one per jaxpr outvar
     constraints: Dict[Var, PartitionSpec]      # interior anchors
     var_strategies: Dict[Var, TensorStrategy]
+    # outvar idx -> invar idx threading (reference input_output_alias_map_);
+    # these invars are safe to donate — the step replaces them.
+    state_alias: Optional[Dict[int, int]] = None
 
     def mesh(self, devices=None) -> Mesh:
         return self.topology.to_jax_mesh(devices)
@@ -146,6 +149,7 @@ class SpmdTransform:
             out_specs=out_specs,
             constraints=constraints,
             var_strategies=combined,
+            state_alias=dict(state_alias) if state_alias else None,
         )
 
     # ------------------------------------------------------------------

@@ -167,15 +167,9 @@ class TepdistServicer:
         if ServiceEnv.get().debug:
             # Reference parity: def-module text dumped per compile
             # (service.cc:732-735) — here the planned jaxpr + specs.
-            dump_dir = os.environ.get("TEPDIST_DUMP_DIR", "/tmp/tepdist_dump")
-            try:
-                os.makedirs(dump_dir, exist_ok=True)
-                with open(os.path.join(dump_dir,
-                                       f"plan_{handle}.jaxpr.txt"), "w") as f:
-                    f.write(str(summary) + "\n\n")
-                    f.write(str(graph.jaxpr))
-            except OSError:
-                log.warning("could not write plan dump to %s", dump_dir)
+            from tepdist_tpu.core.debug_dump import write_dump
+            write_dump(f"plan_{handle}.jaxpr.txt",
+                       f"{summary}\n\n{graph.jaxpr}")
         # Server-side variable initialization (reference: init_from_remote
         # grappler pass + init_specs_map — weights are created on the
         # server's devices with shard-consistent RNG and NEVER travel).

@@ -103,7 +103,15 @@ class TaskScheduler:
                 # PP_BANDWIDTH knob: cross-stage transfer bandwidth override
                 # (reference: PP_BANDWIDTH GB/s, service_env.h:63).
                 return max(n.out_bytes / (env.pp_bandwidth * 1e9), 1e-7)
-            return max(PerfUtils.ppermute_cost(n.out_bytes, self.spec), 1e-7)
+            # Cross-worker hops ride DCN, intra-worker hops ride ICI
+            # (reference: cross-stage transfer on inter-node bandwidth,
+            # evaluator.cc:131).
+            peers = (n.children if n.task_type == TaskType.SEND
+                     else n.parents)
+            over_dcn = any(self.dag.nodes[p].worker_id != n.worker_id
+                           for p in peers)
+            return max(PerfUtils.ppermute_cost(n.out_bytes, self.spec,
+                                               over_dcn=over_dcn), 1e-7)
         if n.task_type == TaskType.AR:
             ndev = max(len(n.device_group), 1)
             return max(PerfUtils.all_reduce_cost(n.out_bytes, ndev, self.spec),

@@ -63,7 +63,10 @@ class TrainingPlan:
 class _SpmdTrainingPlan(TrainingPlan):
     def __init__(self, plan, params, opt_state, n_batch_leaves, devices):
         self._plan = plan
-        self._step_fn = plan.executable(devices=devices)
+        # The plan owns its state arrays and threads outputs back as the
+        # next step's inputs, so the aliased state buffers are donated.
+        self._step_fn = plan.executable(devices=devices,
+                                        donate_invars=plan.state_donation())
         self._shardings = plan.input_shardings(devices)
         self._state_tree = jax.tree_util.tree_structure((params, opt_state))
         flat_state = jax.tree_util.tree_leaves((params, opt_state))
@@ -168,8 +171,30 @@ def explore_parallelism(
     best = min(candidates, key=lambda c: c["cost"].key())
     log.info("exploration winner: %s (duration %.3e s/step) of %d proposals",
              best["kind"], best["cost"].total_duration, len(candidates))
+    if ServiceEnv.get().debug:
+        _dump_candidate_table(candidates, best)
     best["candidates"] = candidates
     return best
+
+
+def _dump_candidate_table(candidates, best) -> None:
+    """DEBUG: ranked per-candidate cost table on disk (reference: candidate
+    strategy dumps, auto_parallel.cc:309-311)."""
+    from tepdist_tpu.core.debug_dump import write_dump
+
+    ranked = sorted(candidates, key=lambda c: c["cost"].key())
+    lines = [f"{'rank':>4} {'kind':>8} {'config':<28} "
+             f"{'duration_s':>12} {'coll%':>6} {'bubble%':>8}"]
+    for r, c in enumerate(ranked):
+        cfg = (str(c["topology"]) if c["kind"] == "spmd" else
+               f"S={c['num_stages']} M={c['num_micro_batches']}")
+        cost = c["cost"]
+        mark = " <== winner" if c is best else ""
+        lines.append(f"{r:>4} {c['kind']:>8} {cfg:<28} "
+                     f"{cost.total_duration:>12.4e} "
+                     f"{100 * cost.coll_ratio:>6.1f} "
+                     f"{100 * cost.bubble_ratio:>8.1f}{mark}")
+    write_dump("exploration_candidates.txt", "\n".join(lines) + "\n")
 
 
 def plan_training(

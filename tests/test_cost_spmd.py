@@ -128,3 +128,59 @@ def test_cost_factor_knob():
         assert scaled == pytest.approx(3.0 * base)
     finally:
         ServiceEnv.reset()
+
+
+def test_ilp_model_export_under_debug(tmp_path, monkeypatch):
+    """DEBUG leaves an LP-format ILP dump on disk (reference
+    ILPModel::ExportToString parity)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tepdist_tpu.core.mesh import MeshTopology
+    from tepdist_tpu.core.service_env import ServiceEnv
+    from tepdist_tpu.graph.jaxpr_graph import trace_graph
+    from tepdist_tpu.parallel.auto_parallel import plan_axes
+
+    monkeypatch.setenv("TEPDIST_DUMP_DIR", str(tmp_path))
+    ServiceEnv.reset({"DEBUG": "1"})
+    try:
+        def f(x, w1, w2):
+            return ((x @ w1) @ w2).sum()
+
+        f32 = jnp.float32
+        graph, _, _ = trace_graph(
+            f, jax.ShapeDtypeStruct((64, 64), f32),
+            jax.ShapeDtypeStruct((64, 64), f32),
+            jax.ShapeDtypeStruct((64, 64), f32))
+        plan_axes(graph, MeshTopology([("data", 4)]))
+        dump = tmp_path / "ilp_spmd_data.lp.txt"
+        assert dump.exists()
+        text = dump.read_text()
+        assert "Minimize" in text and "Subject To" in text \
+            and "Binaries" in text
+    finally:
+        ServiceEnv.reset()
+
+
+def test_graph_strategy_carries_comm_cost():
+    """Cost-planner strategies expose comm_cost (psums + chosen reshard
+    edges) for the Evaluator to fold in; it is <= total_cost."""
+    import jax
+    import jax.numpy as jnp
+
+    from tepdist_tpu.core.mesh import MeshTopology
+    from tepdist_tpu.graph.jaxpr_graph import trace_graph
+    from tepdist_tpu.parallel.auto_parallel import plan_axes
+
+    def loss(w1, w2, x):
+        return jnp.mean(((x @ w1) @ w2) ** 2)
+
+    f32 = jnp.float32
+    graph, _, _ = trace_graph(
+        jax.value_and_grad(loss, (0, 1)),
+        jax.ShapeDtypeStruct((256, 256), f32),
+        jax.ShapeDtypeStruct((256, 256), f32),
+        jax.ShapeDtypeStruct((512, 256), f32))
+    gs = plan_axes(graph, MeshTopology([("data", 8)]))[0]
+    assert gs.comm_cost is not None
+    assert 0.0 <= gs.comm_cost <= gs.total_cost + 1e-12

@@ -297,3 +297,171 @@ def test_opt_level_knob(devices):
         assert np.isfinite(l0)
     finally:
         ServiceEnv.reset()
+
+
+def test_reshard_edges_priced_in_ranking():
+    """VERDICT r1 item 3: two plans with identical FLOPs and no partial
+    sums, differing only in a producer->consumer layout mismatch — they
+    tie unless reshard edges are priced; v2 must rank the consistent plan
+    strictly cheaper."""
+    import dataclasses as _dc
+
+    from tepdist_tpu.core.dist_spec import DimStrategy
+    from tepdist_tpu.parallel.cost_spmd_strategy import GraphStrategy
+
+    def f(x, w):
+        h = x @ w
+        return h * 2.0
+
+    f32 = jnp.float32
+    x = jax.ShapeDtypeStruct((512, 512), f32)
+    w = jax.ShapeDtypeStruct((512, 512), f32)
+    graph, _, _ = trace_graph(f, x, w)
+    topo = MeshTopology([("model", 8)])
+    split0 = DimStrategy(partition_dim=0, num_splits=8)
+    split1 = DimStrategy(partition_dim=1, num_splits=8)
+
+    mm = next(n for n in graph.nodes if "dot" in n.prim)
+    mul = next(n for n in graph.nodes if n.prim == "mul")
+
+    def mk(prod, cons):
+        return GraphStrategy(
+            axis_name="model", num_splits=8,
+            var_strategies={}, node_out={mm.id: [prod], mul.id: [cons]},
+            out_strategies=[cons], total_cost=0.0)
+
+    ev = Evaluator(topo)
+    consistent = ev.run(graph, [mk(split0, split0)])
+    mismatched = ev.run(graph, [mk(split1, split0)])
+    assert consistent.compute_efficiency > mismatched.compute_efficiency
+    assert mismatched.coll_ratio > 0
+    assert consistent.total_duration < mismatched.total_duration
+    # The mismatch cost is exactly a reshard (no partial sums anywhere).
+    assert consistent.coll_ratio == 0.0
+
+
+def test_evaluator_ranking_matches_measured_step_time(devices):
+    """VERDICT r1 item 3 'done' bar: evaluator ranking validated against
+    measured step time on >=3 plans (CPU mesh). On the 1-core virtual mesh
+    wall time tracks TOTAL work, so the measurable contrast is replicated
+    vs sharded compute: the all-replicated rule-mode plan does n_devices x
+    the work and must be ranked AND measured strictly worst — exactly what
+    the round-1 evaluator (total_flops/n_shards for every plan) could not
+    see. The evaluator's winner must measure within 15% of the true best."""
+    import time as _time
+
+    from tepdist_tpu.parallel.auto_parallel import auto_parallel
+
+    def loss(params, x, y):
+        h = jax.nn.relu(x @ params["w1"])
+        return jnp.mean((h @ params["w2"] - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    d = 512
+    params = {"w1": jax.random.normal(k, (d, d)) * 0.05,
+              "w2": jax.random.normal(k, (d, d)) * 0.05}
+    x = jax.random.normal(k, (2048, d))
+    y = jnp.zeros((2048, d))
+    fn = jax.value_and_grad(loss)
+
+    cases = [
+        (MeshTopology([("data", 8)]), "cost"),
+        (MeshTopology([("data", 8)]), "rule"),   # unannotated -> replicated
+        (MeshTopology([("data", 2), ("model", 4)]), "cost"),
+    ]
+    predicted, measured = [], []
+    for topo, mode in cases:
+        graph, _, _ = trace_graph(fn, params, x, y)
+        strategies = plan_axes(graph, topo, None, mode)
+        predicted.append(Evaluator(topo).run(graph, strategies).key())
+        plan = auto_parallel(fn, topo, params, x, y, mode=mode)
+        step = plan.executable()
+        flat = jax.tree_util.tree_leaves(((params, x, y), {}))
+        flat = [jax.device_put(v, s) for v, s in
+                zip(flat, plan.input_shardings())]
+        step(*flat)  # compile
+        best = None
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            for _ in range(5):
+                outs = step(*flat)
+            jax.block_until_ready(outs)
+            dt = _time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        measured.append(best)
+    # The all-replicated plan does 8x the work: worst by both rulers, by a
+    # margin.
+    assert predicted.index(max(predicted)) == 1, predicted
+    assert measured.index(max(measured)) == 1, measured
+    assert measured[1] > 1.5 * min(measured), measured
+    assert predicted[1] > 1.5 * min(predicted), predicted
+    # The evaluator's winner is (close to) the measured winner.
+    win = predicted.index(min(predicted))
+    assert measured[win] <= 1.15 * min(measured), (predicted, measured)
+
+
+def test_pipeline_cost_reports_coll_and_dcn():
+    """run_pipeline returns a real coll_ratio, and cross-worker Send/Recv
+    is priced at DCN bandwidth (slower than intra-worker ICI)."""
+    from tepdist_tpu.runtime.task_graph import TaskDAG, TaskType
+    from tepdist_tpu.runtime.task_scheduler import TaskScheduler
+
+    def build(cross_worker: bool):
+        dag = TaskDAG()
+        prev = None
+        for m in range(4):
+            c0 = dag.add(TaskType.COMPUTE, f"s0m{m}", worker_id=0,
+                         device_group=(0,), stage=0, micro=m,
+                         flops=1e9, out_bytes=1e6)
+            snd = dag.add(TaskType.SEND, f"snd{m}", worker_id=0,
+                          device_group=(0,), stage=0, micro=m,
+                          out_bytes=1e6)
+            rcv = dag.add(TaskType.RECV, f"rcv{m}", stage=1, micro=m,
+                          worker_id=1 if cross_worker else 0,
+                          device_group=(1,), out_bytes=1e6)
+            c1 = dag.add(TaskType.COMPUTE, f"s1m{m}", stage=1, micro=m,
+                         worker_id=1 if cross_worker else 0,
+                         device_group=(1,), flops=1e9, out_bytes=1e6)
+            dag.add_edge(c0, snd)
+            dag.add_edge(snd, rcv)
+            dag.add_edge(rcv, c1)
+            if prev is not None:
+                dag.add_edge(prev, c0)
+            prev = c0
+        return dag
+
+    intra = build(cross_worker=False)
+    cross = build(cross_worker=True)
+    topo = MeshTopology([("stage", 2)])
+    cost_intra = Evaluator(topo).run_pipeline(intra)
+    cost_cross = Evaluator(topo).run_pipeline(cross)
+    assert cost_intra.coll_ratio > 0
+    # Same DAG, but DCN-priced hops must be slower end to end.
+    assert cost_cross.total_duration > cost_intra.total_duration
+    ts_i = TaskScheduler(intra)
+    ts_x = TaskScheduler(cross)
+    snd_i = next(n for n in intra.nodes if n.task_type == TaskType.SEND)
+    snd_x = next(n for n in cross.nodes if n.task_type == TaskType.SEND)
+    assert ts_x.task_time(snd_x) > ts_i.task_time(snd_i)
+
+
+def test_exploration_candidate_table_dump(tmp_path, monkeypatch):
+    """DEBUG exploration leaves a ranked candidate table on disk
+    (reference: per-candidate cost dumps, auto_parallel.cc:309-311)."""
+    from tepdist_tpu.train import _dump_candidate_table
+
+    monkeypatch.setenv("TEPDIST_DUMP_DIR", str(tmp_path))
+    mk = lambda d: Cost(total_duration=d, compute_efficiency=0.5,
+                        coll_ratio=0.1, bubble_ratio=0.0,
+                        peak_bytes_per_device=1e9, memory_feasible=True)
+    cands = [
+        {"kind": "spmd", "topology": MeshTopology([("data", 8)]),
+         "cost": mk(2e-3)},
+        {"kind": "pipeline", "num_stages": 2, "num_micro_batches": 4,
+         "cost": mk(1e-3)},
+    ]
+    _dump_candidate_table(cands, cands[1])
+    text = (tmp_path / "exploration_candidates.txt").read_text()
+    assert "winner" in text and "pipeline" in text and "spmd" in text
+    # Ranked: the pipeline (cheaper) row comes first.
+    assert text.index("pipeline") < text.index("spmd")

@@ -1,0 +1,56 @@
+"""Memory-lean optimizer tests (tepdist_tpu/optim.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tepdist_tpu.optim import adamw_bf16
+
+
+def test_adamw_bf16_tracks_fp32_adamw():
+    """bf16-moment AdamW follows fp32 AdamW closely over a short run and
+    its state really is stored in bfloat16."""
+    def loss(w, x, y):
+        return jnp.mean((x @ w - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    w0 = jax.random.normal(k, (16, 8)) * 0.3
+    x = jax.random.normal(jax.random.fold_in(k, 1), (32, 16))
+    y = jax.random.normal(jax.random.fold_in(k, 2), (32, 8))
+
+    def run(tx):
+        w = w0
+        state = tx.init(w)
+        for _ in range(20):
+            g = jax.grad(loss)(w, x, y)
+            updates, state = tx.update(g, state, w)
+            w = optax.apply_updates(w, updates)
+        return w, state
+
+    w_ref, _ = run(optax.adamw(1e-2, b1=0.9, b2=0.95, weight_decay=0.01))
+    w_bf, state = run(adamw_bf16(1e-2, b1=0.9, b2=0.95, weight_decay=0.01))
+    assert state[0].mu.dtype == jnp.bfloat16
+    assert state[0].nu.dtype == jnp.bfloat16
+    # Trajectories agree to bf16 moment precision.
+    np.testing.assert_allclose(np.asarray(w_bf), np.asarray(w_ref),
+                               atol=5e-3, rtol=5e-2)
+    # And training actually descends.
+    assert loss(w_bf, x, y) < 0.5 * loss(w0, x, y)
+
+
+def test_adamw_bf16_state_bytes_quarter_of_fp32():
+    # fp32 params: optax keeps fp32 moments (12 B/param of state); the
+    # bf16-storage variant keeps 4 B/param. (On bf16 params optax already
+    # stores bf16 moments but computes in bf16 — ours still does fp32
+    # math, only the storage narrows.)
+    params = {"w": jnp.zeros((64, 64), jnp.float32)}
+    s32 = optax.adamw(1e-3).init(params)
+    sbf = adamw_bf16(1e-3).init(params)
+
+    def nbytes(t):
+        # Moment arrays only (the scalar step counter is noise).
+        return sum(x.size * x.dtype.itemsize
+                   for x in jax.tree_util.tree_leaves(t) if x.size > 1)
+
+    assert nbytes(sbf) * 2 <= nbytes(s32)
