@@ -27,7 +27,14 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
     ("COST_FACTOR", float, 1.0, "scale factor on comm costs"),
     ("FP16_COMM", bool, False, "compress gradient all-reduce to bf16 [tpu: bf16]"),
     ("NUM_GRADIENTS", int, -1, "compat: gradients are detected structurally"),
-    ("FORWARD_SUB_GRAPH_NUM", int, -1, "compat: whole-graph ILP (no subgraph cut needed to 24k nodes)"),
+    ("FORWARD_SUB_GRAPH_NUM", int, -1, "compat alias: see SUBGRAPH_NODES"),
+    ("SUBGRAPH_NODES", int, 20000, "graph nodes above which CostSpmdStrategy "
+     "cuts into subgraphs + DP (reference FindSubGraphs; 0 = whole-graph ILP"
+     " always)"),
+    ("SUBGRAPH_BEAM", int, 3, "beam width over boundary-strategy states in "
+     "subgraph DP"),
+    ("SUBGRAPH_WIDTH", int, 4, "max interface vars for the forced-boundary "
+     "DP variant (wider interfaces: natural variant only)"),
     ("VAR_MEM_LIMIT", int, -1, "per-device variable bytes before ZeRO splitting"),
     ("OPT_LEVEL", int, 2, "planner effort: 0 rule, 1 config, 2 exploration"),
     ("UNBALANCED_RATIO", float, 8.0, "pipeline stage flops imbalance tolerance"),

@@ -42,6 +42,12 @@ Var = jexcore.Var
 log = logging.getLogger(__name__)
 
 
+def _strategy_sig(s: Optional[DimStrategy]) -> Optional[DimStrategy]:
+    """Hashable identity of a DimStrategy for DP boundary states.
+    DimStrategy is a frozen dataclass — the instance IS its identity."""
+    return s
+
+
 def transition_cost(src: Optional[DimStrategy], dst: Optional[DimStrategy],
                     bytes_: float, num_splits: int, spec=None) -> float:
     """Cost of converting a tensor from ``src`` to ``dst`` layout on one mesh
@@ -336,11 +342,8 @@ class CostSpmdStrategy:
         walk(v, want, 0)
         return out
 
-    def _solve(self, cones: List[InstCone]) -> Tuple[Dict[int, int], str]:
-        """Pick one strategy per cone + per-variable storage shardings.
-
-        Builds the 0/1 ILP (reference ILPModel::Solve) and falls back to a
-        greedy pick on failure/timeout."""
+    def _prepare(self, cones: List[InstCone]):
+        """Shared demand/edge analysis for all solve paths."""
         self._edges_dropped = 0
         self._node_cone: Dict[int, int] = {}
         for c in cones:
@@ -389,14 +392,43 @@ class CostSpmdStrategy:
                          if s.partition_dim not in self.forbidden.get(v, ())]
                 props.append(DimStrategy.make_replicated(self.n))
                 var_props[v] = props
+        return demands, var_list, var_props, var_producer_cone
 
-        try:
-            choice = self._solve_ilp(cones, demands, var_list, var_props)
-            status = "ilp"
-        except Exception as e:  # noqa: BLE001 — fall back to greedy
-            log.warning("ILP solve failed (%s); falling back to greedy", e)
-            choice = None
-            status = "greedy"
+    def _solve(self, cones: List[InstCone]) -> Tuple[Dict[int, int], str]:
+        """Pick one strategy per cone + per-variable storage shardings.
+
+        Small graphs: one whole-graph 0/1 ILP (reference ILPModel::Solve),
+        greedy fallback. Above SUBGRAPH_NODES: cut into subgraphs at narrow
+        boundaries + beam DP over boundary strategies (reference
+        FindSubGraphs/SubGraphStrategy, cost_spmd_strategy.h:610-898)."""
+        demands, var_list, var_props, var_producer_cone = self._prepare(cones)
+
+        sub_thresh = self.env.subgraph_nodes
+        # Reference-name compat: FORWARD_SUB_GRAPH_NUM counts SUBGRAPHS
+        # (cut into N pieces), not nodes — honor that meaning.
+        n_sub = self.env.forward_sub_graph_num
+        force_segments = n_sub if n_sub > 1 else None
+        choice = None
+        status = "greedy"
+        use_dp = force_segments is not None or (
+            sub_thresh > 0 and len(self.graph.nodes) > sub_thresh)
+        if use_dp and cones:
+            try:
+                choice = self._solve_subgraph_dp(
+                    cones, demands, var_list, var_props, var_producer_cone,
+                    force_segments=force_segments)
+                status = "subgraph-dp"
+            except Exception as e:  # noqa: BLE001 — fall back below
+                log.warning("subgraph DP failed (%s); whole-graph path", e)
+                choice = None
+        if choice is None:
+            try:
+                choice, _obj = self._solve_ilp(cones, demands, var_list,
+                                               var_props)
+                status = "ilp"
+            except Exception as e:  # noqa: BLE001 — fall back to greedy
+                log.warning("ILP solve failed (%s); falling back to greedy", e)
+                choice = None
         if choice is None:
             choice = self._solve_greedy(cones, demands, var_props)
             status = "greedy"
@@ -421,6 +453,168 @@ class CostSpmdStrategy:
                 edge_total += transition_cost(src, want, b, self.n, self.spec)
         self._edge_cost_chosen = edge_total
         return choice, status
+
+    def _solve_subgraph_dp(self, cones, demands, var_list, var_props,
+                           var_producer_cone, force_segments=None
+                           ) -> Optional[Dict[int, int]]:
+        """Subgraph decomposition + beam DP over boundary strategies.
+
+        Reference: ``FindSubGraphs``/``HloSubGraph``/``SubGraphStrategy``
+        (cost_spmd_strategy.h:610-898, driver :913-1257) — the graph is cut
+        at narrow live-cut points so the ILP never sees the whole module;
+        per-subgraph solutions are stitched by dynamic programming over the
+        boundary (head/tail) strategies.
+
+        TPU redesign: cones are ordered by root position; cuts are chosen
+        where at most SUBGRAPH_WIDTH cone-produced vars are live across the
+        boundary. DP state = the strategy assignment of those live vars; a
+        beam of SUBGRAPH_BEAM states survives per boundary. Each transition
+        solves the segment ILP with cross-boundary edges folded into the
+        objective as constants (given the state) — one solve per state,
+        plus one forced-replicated-boundary variant to keep the beam from
+        greedily locking splits that hurt downstream."""
+        env = self.env
+        beam_width = max(1, env.subgraph_beam)
+        force_cap = max(1, env.subgraph_width)
+
+        order = sorted(cones, key=lambda c: c.root.id)
+        pos = {c.id: i for i, c in enumerate(order)}
+
+        # Per produced var: positions of its first and last consumers (for
+        # boundary identification and liveness-aware beam dedup).
+        first_cons: Dict[Var, int] = {}
+        last_cons: Dict[Var, int] = {}
+        for (cid, _pi), lst in demands.items():
+            for kind, key, v, _want in lst:
+                if kind == "cone":
+                    p = pos[cid]
+                    if v not in first_cons or p < first_cons[v]:
+                        first_cons[v] = p
+                    if v not in last_cons or p > last_cons[v]:
+                        last_cons[v] = p
+
+        # Target ~2000-node segments (small enough for sub-second ILPs);
+        # small over-threshold graphs get ~8 segments. Cross-boundary edges
+        # are priced exactly from the accumulated choices, so cuts need no
+        # width restriction — width only caps the forced-boundary variant.
+        thresh = env.subgraph_nodes if env.subgraph_nodes > 0 else 20000
+        if force_segments:
+            nodes_per_seg = max(1, len(self.graph.nodes) // force_segments)
+        else:
+            nodes_per_seg = max(1, min(thresh // 8, 2500),
+                                min(2500, len(self.graph.nodes) // 8))
+        segments: List[List] = []
+        cur: List = []
+        cur_nodes = 0
+        for i, c in enumerate(order):
+            cur.append(c)
+            cur_nodes += len(c.members)
+            if cur_nodes >= nodes_per_seg and i < len(order) - 1:
+                segments.append(cur)
+                cur, cur_nodes = [], 0
+        if cur:
+            segments.append(cur)
+        if len(segments) <= 1:
+            return None              # nothing to decompose
+        log.info("subgraph DP: %d cones -> %d segments (beam %d)",
+                 len(order), len(segments), beam_width)
+
+        rep_sig = _strategy_sig(DimStrategy.make_replicated(self.n))
+
+        def src_of(choice0: Dict[int, int], key: int, v: Var):
+            qi = choice0.get(key)
+            if qi is None:
+                return None          # producer in a LATER segment: unpriced
+            return cones[key].strategies[qi].internal_out.get(v)
+
+        # states: list of (acc_cost, choice {cid: pi})
+        states: List[Tuple[float, Dict[int, int]]] = [(0.0, {})]
+        seg_start = 0
+        for si, seg in enumerate(segments):
+            seg_start += len(seg)
+            seg_ids = {c.id for c in seg}
+            # Restrict the var pseudo-cones to this segment's demands (the
+            # global list would bloat every segment ILP).
+            seg_vars = {v for c in seg for pi in range(len(c.strategies))
+                        for kind, _k, v, _w in demands[(c.id, pi)]
+                        if kind == "var"}
+            seg_var_list = [v for v in var_list if v in seg_vars]
+            # Vars this segment produces that the NEXT segment consumes:
+            # the head/tail interface of the reference's SubGraphStrategy.
+            next_end = seg_start + (len(segments[si + 1])
+                                    if si + 1 < len(segments) else 0)
+            out_vars = [v for v, fc in first_cons.items()
+                        if var_producer_cone[v] in seg_ids
+                        and seg_start <= fc < next_end]
+            # Cross-boundary edges of this segment (state-independent part).
+            cross_edges: List[Tuple[Tuple[int, int], int, Var,
+                                    DimStrategy, float]] = []
+            for c in seg:
+                for pi in range(len(c.strategies)):
+                    for kind, key, v, want in demands[(c.id, pi)]:
+                        if kind == "cone" and key not in seg_ids:
+                            cross_edges.append(((c.id, pi), key, v, want,
+                                                aval_bytes(v.aval)))
+            # Vars still live past this segment's end: the beam dedup key
+            # (skip/residual edges spanning several boundaries included).
+            live_vars = [v for v, lc in last_cons.items()
+                         if lc >= seg_start
+                         and pos[var_producer_cone[v]] < seg_start]
+            new_states: Dict[Tuple, Tuple[float, Dict[int, int]]] = {}
+            solve_cache: Dict[Tuple, Tuple] = {}
+            for acc_cost, choice0 in states:
+                # Cross-boundary edges priced exactly from the accumulated
+                # choices of earlier segments.
+                extra: Dict[Tuple[int, int], float] = {}
+                for cp, key, v, want, b in cross_edges:
+                    w = transition_cost(src_of(choice0, key, v), want,
+                                        b, self.n, self.spec)
+                    if w:
+                        extra[cp] = extra.get(cp, 0.0) + w
+                variants: List[Optional[Dict]] = [None]
+                # The forced-replicated-boundary variant protects the beam
+                # from greedily locking splits that hurt downstream. It runs
+                # for EVERY beam state: restricting it to the best state
+                # measurably degrades plans (the state that needs rescuing
+                # is rarely rank 0).
+                if 0 < len(out_vars) <= force_cap:
+                    variants.append({v: rep_sig for v in out_vars})
+                for force in variants:
+                    # Beam states that agree on this segment's inputs
+                    # produce byte-identical models — solve once.
+                    ck = (tuple(sorted((k, round(v, 15))
+                                       for k, v in extra.items())),
+                          force is None)
+                    if ck in solve_cache:
+                        sub_choice, obj = solve_cache[ck]
+                    else:
+                        sub_choice, obj = self._solve_ilp(
+                            cones, demands, seg_var_list, var_props,
+                            active=seg, extra_cost=extra, force=force,
+                            var_producer_cone=var_producer_cone)
+                        solve_cache[ck] = (sub_choice, obj)
+                    if sub_choice is None:
+                        continue
+                    nchoice = dict(choice0)
+                    nchoice.update(sub_choice)
+                    # Dedup on ALL still-live interface strategies, not just
+                    # the next segment's — a skip edge first consumed two
+                    # segments later must keep its states distinct.
+                    keyb = tuple(sorted(
+                        (id(v), hash(_strategy_sig(
+                            src_of(nchoice, var_producer_cone[v], v))))
+                        for v in set(out_vars) | set(live_vars)))
+                    cand = (acc_cost + obj, nchoice)
+                    if keyb not in new_states or cand[0] < new_states[keyb][0]:
+                        new_states[keyb] = cand
+            if not new_states:
+                return None
+            states = sorted(new_states.values(), key=lambda t: t[0])
+            states = states[:beam_width]
+        best_cost, choice = min(states, key=lambda t: t[0])
+        log.info("subgraph DP done: cost=%.3e over %d segments",
+                 best_cost, len(segments))
+        return choice
 
     def _finalize_var_choice(self, cones, choice, demands, var_props) -> None:
         """Set each input var's storage sharding to the option minimizing
@@ -494,11 +688,26 @@ class CostSpmdStrategy:
         self._var_choice = var_choice
         return choice
 
-    def _solve_ilp(self, cones, demands, var_list, var_props
-                   ) -> Optional[Dict[int, int]]:
-        """0/1 ILP with scipy.optimize.milp (HiGHS)."""
+    def _solve_ilp(self, cones, demands, var_list, var_props,
+                   active=None, extra_cost=None, force=None,
+                   var_producer_cone=None
+                   ) -> Tuple[Optional[Dict[int, int]], float]:
+        """0/1 ILP with scipy.optimize.milp (HiGHS). Returns (choice, obj).
+
+        Subgraph mode extensions (reference per-subgraph ILP inside the
+        FindSubGraphs DP): ``active`` restricts the model to a cone subset
+        (cross-boundary 'cone' demands whose producer is outside are
+        expected to be pre-converted into ``extra_cost`` constants by the
+        caller and are skipped here); ``extra_cost[(cid, pi)]`` adds a
+        constant to that strategy var's objective coefficient; ``force``
+        maps a produced var -> required DimStrategy sig, constraining its
+        producer cone to strategies emitting it."""
         from scipy import sparse
         from scipy.optimize import Bounds, LinearConstraint, milp
+
+        acs = cones if active is None else active
+        active_ids = {c.id for c in acs}
+        extra_cost = extra_cost or {}
 
         # Index x vars: cones then vars then edge vars.
         x_index: Dict[Tuple, int] = {}
@@ -510,9 +719,10 @@ class CostSpmdStrategy:
             obj.append(cost)
             return idx
 
-        for c in cones:
+        for c in acs:
             for pi, cs in enumerate(c.strategies):
-                add_var(("c", c.id, pi), cs.self_cost)
+                add_var(("c", c.id, pi),
+                        cs.self_cost + extra_cost.get((c.id, pi), 0.0))
         for v in var_list:
             for si, s in enumerate(var_props[v]):
                 add_var(("v", id(v), si), 0.0)
@@ -520,32 +730,54 @@ class CostSpmdStrategy:
 
         rows: List[Tuple[List[int], List[float], float, float]] = []
         # One-hot per cone / var.
-        for c in cones:
+        for c in acs:
             idxs = [x_index[("c", c.id, pi)] for pi in range(len(c.strategies))]
             rows.append((idxs, [1.0] * len(idxs), 1.0, 1.0))
         for v in var_list:
             idxs = [x_index[("v", id(v), si)] for si in range(len(var_props[v]))]
             rows.append((idxs, [1.0] * len(idxs), 1.0, 1.0))
+        # Boundary forcing: the producer must emit the demanded strategy.
+        for v, want_sig in (force or {}).items():
+            cp = var_producer_cone[v]
+            allowed = [
+                pi for pi, ps in enumerate(cones[cp].strategies)
+                if _strategy_sig(ps.internal_out.get(v)) == want_sig]
+            if not allowed:
+                return None, float("inf")     # variant infeasible
+            idxs = [x_index[("c", cp, pi)] for pi in allowed]
+            rows.append((idxs, [1.0] * len(idxs), 1.0, 1.0))
 
         # Edge vars with linearization y >= x1 + x2 - 1 (w >= 0).
         n_edges = 0
-        for c in cones:
+        for c in acs:
             for pi, cs in enumerate(c.strategies):
                 i2 = x_index[("c", c.id, pi)]
                 for kind, key, v, want in demands[(c.id, pi)]:
                     b = aval_bytes(v.aval)
                     if kind == "cone":
+                        if key not in active_ids:
+                            continue      # priced via extra_cost constants
                         prod = cones[key]
+                        # Producer strategies emitting the same sharding of
+                        # v share one linearization var: y >= Σ x1 + x2 - 1.
+                        groups: Dict[Tuple, Tuple[float, List[int]]] = {}
                         for qi, ps in enumerate(prod.strategies):
                             src = ps.internal_out.get(v)
                             w = transition_cost(src, want, b, self.n, self.spec)
                             if w <= 0:
                                 continue
-                            i1 = x_index[("c", key, qi)]
+                            sig = _strategy_sig(src)
+                            if sig in groups:
+                                groups[sig][1].append(
+                                    x_index[("c", key, qi)])
+                            else:
+                                groups[sig] = (w, [x_index[("c", key, qi)]])
+                        for w, i1s in groups.values():
                             yi = add_var(("y", n_edges), w)
                             n_edges += 1
-                            # y - x1 - x2 >= -1
-                            rows.append(([yi, i1, i2], [1.0, -1.0, -1.0],
+                            # y - Σx1 - x2 >= -1
+                            rows.append(([yi] + i1s + [i2],
+                                         [1.0] + [-1.0] * len(i1s) + [-1.0],
                                          -1.0, np.inf))
                     else:
                         for si, s in enumerate(var_props[v]):
@@ -560,7 +792,7 @@ class CostSpmdStrategy:
 
         nvars = len(obj)
         if nvars == 0:
-            return {}
+            return {}, 0.0
         data, ri, ci, lo, hi = [], [], [], [], []
         for r, (idxs, coefs, lb, ub) in enumerate(rows):
             for idx, coef in zip(idxs, coefs):
@@ -570,17 +802,34 @@ class CostSpmdStrategy:
             lo.append(lb)
             hi.append(ub)
         A = sparse.csr_matrix((data, (ri, ci)), shape=(len(rows), nvars))
-        if self.env.debug:
+        if self.env.debug and active is None:
+            # Whole-graph mode only: per-segment DP solves would overwrite
+            # the same dump dozens of times.
             self._export_ilp(x_index, obj, rows)
         res = milp(
             c=np.array(obj),
             constraints=LinearConstraint(A, np.array(lo), np.array(hi)),
-            integrality=np.ones(nvars),
+            # Only the x (cone/var choice) vars are binary; the y edge
+            # vars are continuous — with binary x, minimization drives
+            # y = max(0, Σx1 + x2 - 1) exactly, and dropping their
+            # integrality shrinks branch-and-bound by the ~10x edge-var
+            # multiplicity.
+            integrality=np.array(
+                [0.0 if key[0] == "y" else 1.0
+                 for key, _ in sorted(x_index.items(), key=lambda kv: kv[1])]),
             bounds=Bounds(0, 1),
-            options={"time_limit": self.env.ilp_time_limit},
+            options=(
+                {"time_limit": self.env.ilp_time_limit}
+                if active is None else
+                # Segment solves accept a small optimality gap and a tight
+                # wall-clock cap: planner costs are model estimates; proving
+                # the last few percent costs most of the branch-and-bound
+                # time and the DP runs many solves.
+                {"time_limit": min(self.env.ilp_time_limit, 0.8),
+                 "mip_rel_gap": 0.03}),
         )
         if res.x is None:
-            return None
+            return None, float("inf")
         choice: Dict[int, int] = {}
         var_choice: Dict[Var, DimStrategy] = {}
         for key, idx in x_index.items():
@@ -591,7 +840,7 @@ class CostSpmdStrategy:
                     v = var_pos[key[1]]
                     var_choice[v] = var_props[v][key[2]]
         self._var_choice = var_choice
-        return choice
+        return choice, float(res.fun)
 
     def _export_ilp(self, x_index, obj, rows) -> None:
         """DEBUG dump of the ILP in LP-style text (reference
@@ -611,7 +860,14 @@ class CostSpmdStrategy:
                 f"{co:.6g} {names[i]}" for i, co in zip(idxs, coefs))
             op = "=" if lb == ub else ">="
             lines.append(f" r{r}: {terms} {op} {lb:.6g}")
-        lines.append("Binaries\n " + " ".join(names.values()) + "\nEnd")
+        # x (choice) vars are binary; y edge vars are continuous in [0, 1]
+        # (see the integrality array in the solve).
+        lines.append("Bounds")
+        lines.extend(f" 0 <= {n} <= 1" for k, n in
+                     ((k, names[i]) for k, i in x_index.items())
+                     if k[0] == "y")
+        lines.append("Binaries\n " + " ".join(
+            names[i] for k, i in x_index.items() if k[0] != "y") + "\nEnd")
         write_dump(f"ilp_spmd_{self.axis}.lp.txt", "\n".join(lines) + "\n")
 
     # ------------------------------------------------------------------

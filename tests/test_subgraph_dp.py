@@ -1,0 +1,85 @@
+"""Subgraph-DP planner tests (VERDICT r1 item 2; reference
+FindSubGraphs/SubGraphStrategy, cost_spmd_strategy.h:610-898,913-1257)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tepdist_tpu.core.mesh import MeshTopology
+from tepdist_tpu.core.service_env import ServiceEnv
+from tepdist_tpu.graph.jaxpr_graph import trace_graph
+from tepdist_tpu.parallel.auto_parallel import plan_axes
+
+
+def _chain_mlp(n_layers, d, batch, bias=False):
+    def loss(params, x, y):
+        h = x
+        for i in range(n_layers):
+            h = h @ params[f"w{i}"]
+            if bias:
+                h = h + params[f"b{i}"]
+            h = jax.nn.relu(h)
+        return jnp.mean((h - y) ** 2)
+
+    f32 = jnp.float32
+    params = {}
+    for i in range(n_layers):
+        params[f"w{i}"] = jax.ShapeDtypeStruct((d, d), f32)
+        if bias:
+            params[f"b{i}"] = jax.ShapeDtypeStruct((d,), f32)
+    x = jax.ShapeDtypeStruct((batch, d), f32)
+    y = jax.ShapeDtypeStruct((batch, d), f32)
+    return jax.value_and_grad(loss), params, x, y
+
+
+@pytest.mark.parametrize("axes", [[("data", 8)], [("model", 4)]])
+def test_subgraph_dp_matches_whole_graph_ilp(axes):
+    """Forcing subgraph mode on a battery-sized graph reproduces the
+    whole-graph ILP's optimal cost (plans may permute symmetric dims)."""
+    fn, params, x, y = _chain_mlp(8, 256, 512)
+    topo = MeshTopology(axes)
+
+    graph, _, _ = trace_graph(fn, params, x, y)
+    whole = plan_axes(graph, topo)[0]
+    assert whole.ilp_status == "ilp"
+
+    ServiceEnv.reset({"SUBGRAPH_NODES": "10"})
+    try:
+        graph2, _, _ = trace_graph(fn, params, x, y)
+        dp = plan_axes(graph2, topo)[0]
+    finally:
+        ServiceEnv.reset()
+    assert dp.ilp_status == "subgraph-dp"
+    assert abs(dp.total_cost - whole.total_cost) <= (
+        1e-12 + 1e-6 * abs(whole.total_cost)), (dp.total_cost,
+                                                whole.total_cost)
+    # Same sharding decisions for the graph inputs (storage plan).
+    for v, s in whole.var_strategies.items():
+        ds = dp.var_strategies.get(v)
+        assert ds is not None
+        assert ds.is_split() == s.is_split()
+
+
+def test_subgraph_dp_scales_past_whole_graph_ilp():
+    """A deep-chain training graph well past the whole-graph ILP comfort
+    zone plans via subgraph DP in bounded time. (The full 105k-node
+    measurement runs out-of-CI: 105,008 nodes planned in ~80s on a single
+    CPU core at cost 2.39e-4, where the whole-graph ILP needs 230s and
+    returns a ~1000x worse incumbent (0.257) at its time limit; this is
+    the fast regression guard at ~30k nodes.)"""
+    # Dimensions where batch-splitting clearly pays (per-layer compute
+    # saving > per-weight psum alpha cost) so the plan is non-degenerate.
+    fn, params, x, y = _chain_mlp(2200, 256, 4096, bias=True)
+    graph, _, _ = trace_graph(fn, params, x, y)
+    assert len(graph.nodes) > 25000
+    t0 = time.time()
+    gs = plan_axes(graph, MeshTopology([("data", 8)]))[0]
+    dt = time.time() - t0
+    assert gs.ilp_status == "subgraph-dp"
+    assert dt < 90, f"subgraph DP took {dt:.1f}s"
+    # The plan is non-degenerate: batch-split compute, sharded storage.
+    n_split = sum(1 for outs in gs.node_out.values()
+                  for s in outs if s is not None and s.is_split())
+    assert n_split > 1000
