@@ -120,7 +120,22 @@ def plan_axes(
         if size <= 1:
             continue
         fixed = fixed_per_axis.get(name, {})
-        if mode == "rule":
+        if name == "seq":
+            # Reserved: the sequence axis is owned by the ring-attention
+            # rewrite (parallel/attention_motif.py). When the graph still
+            # carries closed motifs (forward graph) a seq GraphStrategy
+            # prices + propagates it and the SPMD transform rewrites the
+            # motifs; on an already-rewritten graph the shard_map anchors
+            # own the sharding and the axis is skipped.
+            from tepdist_tpu.parallel.attention_motif import (
+                build_seq_strategy,
+                detect_motifs,
+            )
+            motifs = detect_motifs(graph)
+            if not motifs:
+                continue
+            gs = build_seq_strategy(graph, size, motifs)
+        elif mode == "rule":
             gs = FastSpmdStrategy(graph, name, size, fixed).run()
         else:
             gs = CostSpmdStrategy(

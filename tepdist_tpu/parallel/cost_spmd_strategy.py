@@ -127,6 +127,9 @@ class GraphStrategy:
     # produced by the cost planner (e.g. rule mode / hand-made) — the
     # Evaluator then falls back to re-deriving edge costs.
     comm_cost: Optional[float] = None
+    # Attention motifs to rewrite into ring attention (seq axis only;
+    # parallel/attention_motif.py). The SPMD transform consumes these.
+    motifs: Optional[List] = None
 
 
 class CostSpmdStrategy:

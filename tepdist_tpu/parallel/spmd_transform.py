@@ -67,6 +67,10 @@ class ShardingPlan:
     # outvar idx -> invar idx threading (reference input_output_alias_map_);
     # these invars are safe to donate — the step replaces them.
     state_alias: Optional[Dict[int, int]] = None
+    # (axis_name, motifs) pairs from seq-axis strategies: the executable
+    # rewrites these eqn clusters into ops.ring_attention instead of
+    # letting GSPMD all-gather K/V (parallel/attention_motif.py).
+    motifs: Optional[List] = None
 
     def mesh(self, devices=None) -> Mesh:
         return self.topology.to_jax_mesh(devices)
@@ -143,6 +147,8 @@ class SpmdTransform:
                 spec = ts.partition_spec(len(ov.aval.shape))
                 if spec != PartitionSpec():
                     constraints[ov] = spec
+        motif_axes = [(gs.axis_name, gs.motifs) for gs in strategies
+                      if getattr(gs, "motifs", None)]
         return ShardingPlan(
             topology=self.topology,
             in_specs=in_specs,
@@ -150,6 +156,7 @@ class SpmdTransform:
             constraints=constraints,
             var_strategies=combined,
             state_alias=dict(state_alias) if state_alias else None,
+            motifs=motif_axes or None,
         )
 
     # ------------------------------------------------------------------
@@ -172,6 +179,14 @@ class SpmdTransform:
             v: NamedSharding(mesh, spec)
             for v, spec in (plan.constraints.items() if constrain_interior else ())
         }
+        # Seq-axis motif rewrites: skip the softmax(QK^T)V eqn clusters and
+        # emit ring attention at the PV dot (K/V stay sequence-sharded).
+        skip_ids: set = set()
+        at_pv: Dict[int, Any] = {}
+        for axis_name, motifs in (plan.motifs or ()):
+            for m in motifs:
+                skip_ids |= m.member_ids
+                at_pv[m.pv_id] = (axis_name, m)
 
         def run(*flat_args):
             env: Dict[Var, Any] = {}
@@ -191,9 +206,23 @@ class SpmdTransform:
                 write(cv, c)
             for iv, a in zip(jaxpr.invars, flat_args):
                 write(iv, a)
-            for eqn in jaxpr.eqns:
+            for i, eqn in enumerate(jaxpr.eqns):
+                if i in at_pv:
+                    axis_name, m = at_pv[i]
+                    from tepdist_tpu.ops.ring_attention import ring_attention
+                    o = ring_attention(read(m.q), read(m.k), read(m.v),
+                                       mesh, axis_name, causal=m.causal,
+                                       scale=m.scale)
+                    write(m.out, o.astype(m.out.aval.dtype))
+                    continue
+                if i in skip_ids:
+                    continue
                 vals = [read(a) for a in eqn.invars]
-                outs = eqn.primitive.bind(*vals, **eqn.params)
+                # get_bind_params: staged params -> bindable form (how
+                # eval_jaxpr re-binds pjit/shard_map/custom_* eqns).
+                subfuns, bind_params = eqn.primitive.get_bind_params(
+                    eqn.params)
+                outs = eqn.primitive.bind(*subfuns, *vals, **bind_params)
                 if not eqn.primitive.multiple_results:
                     outs = [outs]
                 for ov, val in zip(eqn.outvars, outs):

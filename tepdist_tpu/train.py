@@ -71,6 +71,12 @@ class _SpmdTrainingPlan(TrainingPlan):
         self._state_tree = jax.tree_util.tree_structure((params, opt_state))
         flat_state = jax.tree_util.tree_leaves((params, opt_state))
         self._n_state = len(flat_state)
+        # OWNERSHIP TRANSFER: the step donates the state buffers (without
+        # donation the training state is double-buffered every step — OOM
+        # at GPT-2 1.5B scale on one chip), and device_put shares buffers
+        # with compatible inputs. The caller's params/opt_state arrays are
+        # therefore moved-from after the first step; read state back via
+        # ``variables()``. DISABLE_BUFFER_ALIAS=1 opts out.
         self._state = [jax.device_put(v, s) for v, s in
                        zip(flat_state, self._shardings[:self._n_state])]
         self._batch_shardings = self._shardings[self._n_state:]
@@ -148,6 +154,64 @@ def explore_parallelism(
             log.info("spmd proposal %s failed: %s", topo, e)
     batch0 = jax.tree_util.tree_leaves(example_batch)[0]
     batch_rows = batch0.shape[0]
+    # Sequence-parallel proposals (SURVEY §5.7): when the loss contains
+    # attention motifs, data x seq meshes compete — the seq axis is priced
+    # with the ring-attention cost (fwd ring + reverse ring in backward).
+    from tepdist_tpu.parallel.attention_motif import detect_motifs
+
+    motifs = detect_motifs(graph, allow_escape=True)
+    if motifs:
+        for s in (2, 4, 8, 16):
+            if s > n_devices or n_devices % s:
+                continue
+            d = n_devices // s
+            if any(m.seq_len % s for m in motifs) or batch_rows % max(d, 1):
+                continue
+            axes = ([("data", d)] if d > 1 else []) + [("seq", s)]
+            topo = MeshTopology(axes)
+            try:
+                from tepdist_tpu.parallel.attention_motif import (
+                    ring_comm_cost,
+                )
+                from tepdist_tpu.parallel.evaluator import Cost
+                from tepdist_tpu.parallel.performance_utils import (
+                    PerfUtils,
+                    chip_spec,
+                )
+                from tepdist_tpu.parallel.sync_free import (
+                    estimate_peak_activation_bytes,
+                )
+
+                # A data x seq mesh shards a transformer's whole compute
+                # (every tensor carries the batch or token dim); comm =
+                # the data axis's own pricing (grad psums) + the exposed
+                # ring (fwd + reverse) — the backward nodes are invisible
+                # to the fwd-seeded propagation, so the generic evaluator
+                # would overprice seq compute.
+                spec = chip_spec()
+                comm = ring_comm_cost(motifs, s, spec, with_backward=True)
+                if d > 1:
+                    gs_d = plan_axes(graph, MeshTopology([("data", d)]),
+                                     None, "cost")[0]
+                    comm += gs_d.comm_cost or 0.0
+                compute_t = PerfUtils.compute_time(
+                    graph.total_flops() / n_devices, spec)
+                from tepdist_tpu.graph.cost import aval_bytes as _ab
+                var_bytes = sum(_ab(v.aval) for v in graph.invars)
+                act = estimate_peak_activation_bytes(graph) / n_devices
+                total = compute_t + comm
+                budget = spec.hbm_gb * 1e9 * 0.9
+                cost = Cost(
+                    total_duration=total,
+                    compute_efficiency=compute_t / total if total else 0.0,
+                    coll_ratio=comm / total if total else 0.0,
+                    bubble_ratio=0.0,
+                    peak_bytes_per_device=var_bytes + act,
+                    memory_feasible=var_bytes + act <= budget)
+                candidates.append({"kind": "spmd", "topology": topo,
+                                   "cost": cost})
+            except Exception as e:  # noqa: BLE001 — infeasible proposal
+                log.info("seq proposal seq=%d failed: %s", s, e)
     for S in (2, 4, 8):
         if S > n_devices or n_devices % S:
             continue
@@ -213,7 +277,13 @@ def plan_training(
 ) -> TrainingPlan:
     """Plan + compile a full training loop for ``loss_fn(params, *batch)``
     with an optax ``optimizer``. ``explore=True`` (or OPT_LEVEL=2 with no
-    topology/stages given) searches SPMD *and* pipeline proposals."""
+    topology/stages given) searches SPMD *and* pipeline proposals.
+
+    Ownership: the returned plan DONATES its state buffers each step, and
+    the initial placement may share buffers with ``params``/the derived
+    optimizer state — treat them as moved-from after the first ``step()``
+    and read state back via ``plan.variables()`` (DISABLE_BUFFER_ALIAS=1
+    opts out of donation)."""
     env = ServiceEnv.get()
     devices = list(devices if devices is not None else jax.devices())
     # OPT_LEVEL (reference planner-effort switch): 0 = rule mode,
@@ -236,6 +306,34 @@ def plan_training(
         num_stages = env.num_stages if env.num_stages > 0 else 1
 
     import optax  # noqa: F401 — required peer
+
+    # Sequence axis: rewrite attention motifs into ring attention BEFORE
+    # differentiation — value_and_grad of the rewritten forward traces the
+    # reverse ring, so the sequence dim stays sharded in both directions
+    # (parallel/attention_motif.py; SURVEY §5.7 mandate). Runs before the
+    # REMAT wrap: tracing inlines remat2, so wrapping must come after.
+    if topology is not None and any(
+            n == "seq" and s > 1 for n, s in topology.device_axes()):
+        from tepdist_tpu.graph.jaxpr_graph import trace_graph as _tg
+        from tepdist_tpu.parallel.attention_motif import (
+            build_ring_rewritten,
+            detect_motifs,
+        )
+
+        g_loss, _, _ = _tg(loss_fn, params, *example_batch)
+        motifs = detect_motifs(g_loss)
+        if not motifs:
+            raise ValueError("topology has a 'seq' axis but the loss has "
+                             "no rewritable attention motif")
+        seq_mesh = topology.to_jax_mesh(devices)
+        _rw = build_ring_rewritten(g_loss, motifs, seq_mesh, "seq")
+
+        def loss_fn(p, *b):  # noqa: F811 — deliberate rebind
+            flat, _ = jax.tree_util.tree_flatten(((p, *b), {}))
+            return _rw(*flat)[0]
+
+        log.info("seq axis: %d attention motif(s) -> ring attention",
+                 len(motifs))
 
     # REMAT_POLICY knob: rematerialization trades FLOPs for activation
     # memory (jax.checkpoint; the stage modules already remat via VJP).
