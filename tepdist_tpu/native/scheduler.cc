@@ -4,20 +4,25 @@
 // TaskScheduler::Schedule (reference: pjrt/task_scheduler.{h,cc} —
 // ClusterState::ScheduleNextTask / MarkTaskDoneByTime per device until
 // AllFinished). The Python layer builds the DAG and interprets the result;
-// this core runs the O(N log N) list-scheduling simulation, which dominates
-// planner time for large (stage x micro) DAGs.
+// this core runs the event-driven simulation, which dominates planner time
+// for large (stage x micro) DAGs.
 //
-// Priority policy mirrors tepdist_tpu/runtime/task_scheduler.py exactly
-// (asserted equal in tests): 1F1B via the in-flight micro-batch window.
+// A task starts only when every parent has FINISHED in simulated time and
+// all its devices are free at the current instant; the 1F1B window is a
+// hard admission gate (a forward of a new micro may not start while
+// `window` micros are in flight on its stage). Mirrors
+// tepdist_tpu/runtime/task_scheduler.py::_simulate_py exactly (asserted
+// bit-identical in tests).
 //
 // Build: g++ -O2 -shared -fPIC scheduler.cc -o libtepdist_sched.so
 
 #include <cstdint>
-#include <cstring>
+#include <functional>
 #include <queue>
 #include <set>
 #include <tuple>
 #include <unordered_map>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -26,17 +31,6 @@ enum TaskKind : int32_t {
   kComputeFwd = 0,
   kComputeBwd = 1,
   kOther = 2,
-};
-
-struct Prio {
-  int32_t cls;        // 1 if fwd beyond window, else 0
-  int32_t micro;
-  int32_t bwd_bonus;  // 0 for bwd, 1 otherwise
-  int32_t id;
-  bool operator>(const Prio& o) const {
-    return std::tie(cls, micro, bwd_bonus, id) >
-           std::tie(o.cls, o.micro, o.bwd_bonus, o.id);
-  }
 };
 
 }  // namespace
@@ -57,64 +51,82 @@ extern "C" int tepdist_schedule(
     double* out_start,            // [n_tasks]
     double* out_finish) {         // [n_tasks]
   std::vector<int32_t> indeg(n_parents, n_parents + n_tasks);
-  std::vector<double> ready_time(n_tasks, 0.0);
   std::unordered_map<int32_t, double> dev_free;
-  // inflight[stage] = set of micro ids with fwd started, bwd not finished
+  // inflight[stage] = micros with fwd STARTED, bwd not FINISHED.
   std::unordered_map<int32_t, std::set<int32_t>> inflight;
 
-  auto priority = [&](int32_t t) -> Prio {
-    bool is_fwd = kind[t] == kComputeFwd;
-    bool is_bwd = kind[t] == kComputeBwd;
-    bool stage_full = is_fwd && window > 0 &&
-        (int32_t)inflight[stage[t]].size() >= window;
-    return Prio{stage_full ? 1 : 0, micro[t] >= 0 ? micro[t] : 0,
-                is_bwd ? 0 : 1, t};
-  };
-
-  using Entry = std::pair<Prio, int32_t>;
-  auto cmp = [](const Entry& a, const Entry& b) { return a.first > b.first; };
-  std::priority_queue<Entry, std::vector<Entry>, decltype(cmp)> ready(cmp);
-
+  std::vector<int32_t> pool;  // time-ready (all parents finished)
+  pool.reserve(n_tasks);
   for (int32_t t = 0; t < n_tasks; ++t) {
-    if (indeg[t] == 0) ready.push({priority(t), t});
+    if (indeg[t] == 0) pool.push_back(t);
   }
 
+  using Ev = std::pair<double, int32_t>;  // (finish time, task id)
+  std::priority_queue<Ev, std::vector<Ev>, std::greater<Ev>> events;
+  double t_now = 0.0;
   int32_t done = 0;
-  while (!ready.empty()) {
-    auto [pr, t] = ready.top();
-    ready.pop();
-    // Lazy re-prioritization: window state may have changed since push.
-    Prio cur = priority(t);
-    if (!ready.empty()) {
-      Prio best_waiting = ready.top().first;
-      if (cur > best_waiting) {
-        ready.push({cur, t});
-        auto [pr2, t2] = ready.top();
-        ready.pop();
-        t = t2;
-        cur = priority(t);
+
+  using Prio = std::tuple<int32_t, int32_t, int32_t>;  // micro, bwd, id
+  auto try_start = [&]() -> bool {
+    int32_t best = -1;
+    size_t best_idx = 0;
+    Prio best_pr{};
+    for (size_t pi = 0; pi < pool.size(); ++pi) {
+      int32_t t = pool[pi];
+      bool devs_free = true;
+      for (int32_t i = dev_offsets[t]; i < dev_offsets[t + 1]; ++i) {
+        auto it = dev_free.find(dev_ids[i]);
+        if (it != dev_free.end() && it->second > t_now) {
+          devs_free = false;
+          break;
+        }
+      }
+      if (!devs_free) continue;
+      bool is_fwd = kind[t] == kComputeFwd;
+      bool is_bwd = kind[t] == kComputeBwd;
+      if (is_fwd && window > 0) {
+        auto& s = inflight[stage[t]];
+        if (!s.count(micro[t]) && (int32_t)s.size() >= window) {
+          continue;  // 1F1B gate: stage window full
+        }
+      }
+      Prio pr{micro[t] >= 0 ? micro[t] : 0, is_bwd ? 0 : 1, t};
+      if (best < 0 || pr < best_pr) {
+        best = t;
+        best_idx = pi;
+        best_pr = pr;
       }
     }
-    double t0 = ready_time[t];
-    for (int32_t i = dev_offsets[t]; i < dev_offsets[t + 1]; ++i) {
-      auto it = dev_free.find(dev_ids[i]);
-      if (it != dev_free.end() && it->second > t0) t0 = it->second;
-    }
-    double t1 = t0 + duration[t];
-    out_order[done] = t;
-    out_start[t] = t0;
-    out_finish[t] = t1;
+    if (best < 0) return false;
+    pool.erase(pool.begin() + best_idx);
+    double fin = t_now + duration[best];
+    out_order[done] = best;
+    out_start[best] = t_now;
+    out_finish[best] = fin;
     ++done;
-    for (int32_t i = dev_offsets[t]; i < dev_offsets[t + 1]; ++i) {
-      dev_free[dev_ids[i]] = t1;
+    for (int32_t i = dev_offsets[best]; i < dev_offsets[best + 1]; ++i) {
+      dev_free[dev_ids[i]] = fin;
     }
-    if (kind[t] == kComputeFwd) inflight[stage[t]].insert(micro[t]);
-    if (kind[t] == kComputeBwd) inflight[stage[t]].erase(micro[t]);
-    for (int32_t i = child_offsets[t]; i < child_offsets[t + 1]; ++i) {
-      int32_t c = child_ids[i];
-      if (ready_time[c] < t1) ready_time[c] = t1;
-      if (--indeg[c] == 0) ready.push({priority(c), c});
+    if (kind[best] == kComputeFwd) inflight[stage[best]].insert(micro[best]);
+    events.push({fin, best});
+    return true;
+  };
+
+  while (done < n_tasks) {
+    while (try_start()) {
+    }
+    if (events.empty()) return 1;  // deadlock (cycle or gated forever)
+    t_now = events.top().first;
+    // Drain every completion at this instant before starting more work.
+    while (!events.empty() && events.top().first == t_now) {
+      int32_t t = events.top().second;
+      events.pop();
+      if (kind[t] == kComputeBwd) inflight[stage[t]].erase(micro[t]);
+      for (int32_t i = child_offsets[t]; i < child_offsets[t + 1]; ++i) {
+        int32_t c = child_ids[i];
+        if (--indeg[c] == 0) pool.push_back(c);
+      }
     }
   }
-  return done == n_tasks ? 0 : 1;  // 1 = deadlock (cycle)
+  return 0;
 }

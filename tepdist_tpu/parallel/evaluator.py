@@ -189,10 +189,14 @@ class Evaluator:
         from tepdist_tpu.runtime.task_graph import TaskType
         from tepdist_tpu.runtime.task_scheduler import TaskScheduler
 
-        ts = TaskScheduler(dag, chip=chip or self.spec)
+        spec = chip or self.spec
+        budget = spec.hbm_gb * 1e9 * self.usage_ratio
+        # The scheduler enforces the memory budget itself: OOM candidate
+        # windows are rejected during the search (a wider/narrower 1F1B
+        # window is chosen), not merely reported after the fact.
+        ts = TaskScheduler(dag, chip=spec, mem_limit_bytes=budget)
         sched = ts.schedule()
         peak = max(sched.peak_bytes.values(), default=0.0)
-        budget = self.spec.hbm_gb * 1e9 * self.usage_ratio
         busy = 1.0 - sched.bubble_ratio
         devices = {d for n in dag.nodes for d in n.device_group} or {0}
         comm_t = sum(
@@ -205,5 +209,5 @@ class Evaluator:
             coll_ratio=min(coll, 1.0),
             bubble_ratio=sched.bubble_ratio,
             peak_bytes_per_device=peak,
-            memory_feasible=peak <= budget,
+            memory_feasible=sched.memory_feasible,
         )

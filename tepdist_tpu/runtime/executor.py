@@ -114,8 +114,48 @@ class PipelineExecutable:
         self.opt_states: Dict[int, Any] = {}
         self.params_tree = None
         self.global_step = 0
+        self._param_cache: Dict[Tuple[int, int], Tuple[Any, Any]] = {}
+        self._apply_jit: Dict[int, Callable] = {}
 
     # ------------------------------------------------------------------
+    def _stage_sharding_for(self, s: int, aval) -> NamedSharding:
+        """The placement rule every producer/consumer agrees on: micro-batch
+        tensors (leading dim == micro rows) shard over the intra axis under
+        PP x DP; everything else replicates on the stage's devices."""
+        if (self.intra_dp and getattr(aval, "ndim", 0) >= 1):
+            micro_rows = self.prog.graph.invars[
+                self.prog.batch_flat_indices[0]].aval.shape[
+                self.prog.batch_dim]
+            if aval.shape[0] == micro_rows:
+                return self.stage_batch_shardings[s]
+        return self.stage_shardings[s]
+
+    def _pos_sharding(self, s: int, mod, pos: int) -> NamedSharding:
+        """Placement of stage input ``pos``: params replicate, batch args
+        and interior activations follow the micro-rows rule."""
+        src = mod.input_def_map[pos]
+        if src[0] == "arg" and src[1] not in set(
+                self.prog.batch_flat_indices):
+            return self.stage_shardings[s]
+        return self._stage_sharding_for(s, mod.invars[pos].aval)
+
+    def _aot(self, fn: Callable, s: int, in_avals, in_shs, out_avals,
+             out_shs, donate: Tuple[int, ...] = ()) -> Callable:
+        """AOT-compile ``fn`` with every input/output pinned to an agreed
+        placement (reference: per-device static task lists dispatch
+        pre-built executables, virtual_client.cc:1662-1807 — no per-call
+        tracing, no per-arg resharding). Falls back to plain jit if the
+        AOT path rejects the signature."""
+        try:
+            jfn = jax.jit(fn, out_shardings=out_shs,
+                          donate_argnums=donate or None)
+            sds = [jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+                   for a, sh in zip(in_avals, in_shs)]
+            return jfn.lower(*sds).compile()
+        except Exception as e:  # noqa: BLE001 — keep the jit fallback path
+            log.info("AOT compile fell back to jit for stage %d: %s", s, e)
+            return jax.jit(fn)
+
     def _compile_payloads(self) -> None:
         prog = self.prog
         S = prog.num_stages
@@ -165,7 +205,8 @@ class PipelineExecutable:
                         if s == loss_stage else None)
 
             def make_bwd(fwd=fwd, wired=tuple(wired), out_avals=tuple(out_avals),
-                         loss_out=loss_out, n_in=len(mod.invars)):
+                         loss_out=loss_out, n_in=len(mod.invars),
+                         in_avals_=tuple(v.aval for v in mod.invars)):
                 def bwd(*args):
                     ins = args[:n_in]
                     cots_in = args[n_in:]
@@ -179,29 +220,68 @@ class PipelineExecutable:
                         else:
                             cots.append(jnp.zeros(av.shape, av.dtype))
                     _, vjp_fn = jax.vjp(fwd, *ins)
-                    return vjp_fn(tuple(cots))
+                    grads = vjp_fn(tuple(cots))
+                    # VJP emits float0 for integer inputs (token slices);
+                    # the wire format carries primal-dtype zeros instead —
+                    # the AOT signature is static.
+                    return tuple(
+                        jnp.zeros(a.shape, a.dtype)
+                        if getattr(g, "dtype", None) == jax.dtypes.float0
+                        else g
+                        for g, a in zip(grads, in_avals_))
                 return bwd
 
-            self._fwd_jit.append(jax.jit(fwd))
-            self._bwd_jit.append(jax.jit(make_bwd()))
+            in_avals = [v.aval for v in mod.invars]
+            in_shs = [self._pos_sharding(s, mod, p)
+                      for p in range(len(in_avals))]
+            fwd_out_avals = tuple(v.aval for v in mod.outvars)
+            fwd_out_shs = tuple(self._stage_sharding_for(s, a)
+                                for a in fwd_out_avals)
+            self._fwd_jit.append(self._aot(
+                fwd, s, in_avals, in_shs, fwd_out_avals, fwd_out_shs))
+
+            # bwd returns the VJP w.r.t. every stage input (grads for params,
+            # cotangents for interior activations) — all placed by the same
+            # rule the consumers (GA / SEND / cross-stage RECV) assume.
+            bwd_in_avals = in_avals + [mod.outvars[k].aval for k in wired]
+            bwd_in_shs = in_shs + [self._stage_sharding_for(
+                s, mod.outvars[k].aval) for k in wired]
+            bwd_out_avals = tuple(in_avals)
+            bwd_out_shs = tuple(in_shs)
+            self._bwd_jit.append(self._aot(
+                make_bwd(), s, bwd_in_avals, bwd_in_shs,
+                bwd_out_avals, bwd_out_shs))
 
             ppos = self._stage_ppos[s]
+            param_avals = tuple(mod.invars[p].aval for p in ppos)
+            param_shs = tuple(self.stage_shardings[s] for _ in ppos)
+            # GA flattens (acc tuple, bwd_outs tuple) positionally; the
+            # accumulator is donated — only its chain consumes it.
+            n_acc = len(param_avals)
 
-            def make_ga(ppos=ppos):
-                def ga(acc, bwd_outs):
+            def make_ga_flat(ppos=ppos, n_acc=n_acc):
+                def ga(*args):
+                    acc = args[:n_acc]
+                    bwd_outs = args[n_acc:]
                     return tuple(a + bwd_outs[p] for a, p in zip(acc, ppos))
                 return ga
 
-            self._ga_jit.append(jax.jit(make_ga()))
-
-            param_avals = tuple(mod.invars[p].aval for p in ppos)
+            self._ga_jit.append(self._aot(
+                make_ga_flat(), s,
+                list(param_avals) + list(in_avals),
+                list(param_shs) + list(bwd_out_shs),
+                param_avals, param_shs,
+                donate=tuple(range(n_acc))))
+            self._n_acc = getattr(self, "_n_acc", {})
+            self._n_acc[s] = n_acc
 
             def make_gainit(avals=param_avals):
                 def gi():
                     return tuple(jnp.zeros(a.shape, a.dtype) for a in avals)
                 return gi
 
-            self._gainit.append(jax.jit(make_gainit()))
+            self._gainit.append(self._aot(
+                make_gainit(), s, [], [], param_avals, param_shs))
 
     # ------------------------------------------------------------------
     # Variable management (server-held; reference RegisteredForVariable /
@@ -224,10 +304,18 @@ class PipelineExecutable:
                 self.opt_states[s] = self.optimizer.init(sub)
 
     def _stage_param(self, s: int, i: int):
-        """Param value for stage ``s``: owner's copy, broadcast if shared."""
+        """Param value for stage ``s``: owner's copy, broadcast if shared.
+        Broadcasts are cached per step — params change once per step (at
+        APPLY), not once per consuming task."""
         val = self.var_store[i]
         if self.param_owner.get(i, s) != s:
-            val = jax.device_put(val, self.stage_shardings[s])
+            key = (s, i)
+            cached = self._param_cache.get(key)
+            if cached is not None and cached[0] is val:
+                return cached[1]
+            put = jax.device_put(val, self.stage_shardings[s])
+            self._param_cache[key] = (val, put)
+            return put
         return val
 
     def _put_stage(self, s: int, val):
@@ -333,7 +421,7 @@ class PipelineExecutable:
                 (bwd_pid, bwd_oi) = node.input_specs[1]
                 acc = outputs[acc_pid][acc_oi]
                 bwd_outs = outputs[bwd_pid]
-                outputs[tid] = (self._ga_jit[s](acc, bwd_outs),)
+                outputs[tid] = (self._ga_jit[s](*acc, *bwd_outs),)
             elif tt == TaskType.APPLY:
                 (pid, oi) = node.input_specs[0]
                 acc = outputs[pid][oi]
@@ -363,27 +451,46 @@ class PipelineExecutable:
     def _apply_stage(self, s: int, acc: Tuple, M: int,
                      extras: Optional[Dict[int, Tuple]] = None) -> None:
         """Apply gradients for params OWNED by stage ``s``, summing shared
-        params' contributions from other stages' GA accumulators."""
-        idxs_all = self._stage_pidx[s]
-        owned = [i for i in idxs_all if self.param_owner[i] == s]
-        grads: Dict[int, Any] = {}
-        for i, g in zip(idxs_all, acc):
-            if self.param_owner[i] == s:
-                grads[i] = g
-        for t, eacc in (extras or {}).items():
-            for i, g in zip(self._stage_pidx[t], eacc):
-                if self.param_owner.get(i) == s and i in grads:
-                    grads[i] = jax.device_put(
-                        g, self.stage_shardings[s]) + grads[i]
-        grads = {i: g / M for i, g in grads.items()}
+        params' contributions from other stages' GA accumulators. The whole
+        update (grad average + optimizer + apply) runs as ONE jitted call
+        with donated state (the round-1 version ran optax op-by-op eagerly
+        — dozens of dispatches per step)."""
+        contrib = tuple(sorted((extras or {}).keys()))
+        key = (s, contrib)
+        if key not in self._apply_jit:
+            idxs_all = self._stage_pidx[s]
+            owner = self.param_owner
+            pidx_of = {t: self._stage_pidx[t] for t in contrib}
+            optimizer = self.optimizer
+
+            def apply(params, opt_state, acc, *eaccs):
+                grads = {i: g for i, g in zip(idxs_all, acc)
+                         if owner[i] == s}
+                for t, eacc in zip(contrib, eaccs):
+                    for i, g in zip(pidx_of[t], eacc):
+                        if owner.get(i) == s and i in grads:
+                            grads[i] = grads[i] + g
+                grads = {i: g / M for i, g in grads.items()}
+                if optimizer is None:
+                    return ({i: params[i] - 0.01 * grads[i]
+                             for i in params}, opt_state)
+                updates, new_opt = optimizer.update(grads, opt_state, params)
+                import optax
+                return optax.apply_updates(params, updates), new_opt
+
+            # Nothing is donated here: params may share buffers with the
+            # caller's arrays (load_variables device_put aliases when
+            # layouts match), and with tied params another stage's APPLY
+            # reads this stage's final accumulator as an extra.
+            self._apply_jit[key] = jax.jit(apply)
+
+        owned = [i for i in self._stage_pidx[s] if self.param_owner[i] == s]
         params = {i: self.var_store[i] for i in owned}
-        if self.optimizer is None:
-            for i in owned:
-                self.var_store[i] = params[i] - 0.01 * grads[i]
-            return
-        updates, self.opt_states[s] = self.optimizer.update(
-            grads, self.opt_states[s], params)
-        import optax
-        new_params = optax.apply_updates(params, updates)
+        # Cross-stage accumulators must land on this stage's devices before
+        # they can join the jitted update.
+        eaccs = [tuple(jax.device_put(g, self.stage_shardings[s])
+                       for g in extras[t]) for t in contrib] if contrib else []
+        new_params, self.opt_states[s] = self._apply_jit[key](
+            params, self.opt_states[s], acc, *eaccs)
         for i in owned:
             self.var_store[i] = new_params[i]

@@ -32,6 +32,11 @@ class ScheduleResult:
     makespan: float
     peak_bytes: Dict[int, float]              # per global device id
     bubble_ratio: float
+    # Whether max(peak_bytes) fits the scheduler's mem_limit_bytes (always
+    # True when no limit is set). Reference: DevState OOM accounting,
+    # pjrt/task_scheduler.h:86-180 — an OOM schedule is never selected
+    # while a feasible candidate window exists.
+    memory_feasible: bool = True
 
     def device_list(self, dev: int) -> List[int]:
         out = []
@@ -123,19 +128,41 @@ class TaskScheduler:
     # -- scheduling -------------------------------------------------------
     def schedule(self) -> ScheduleResult:
         """Try GROUP_SCHED_COUNT window policies, keep the best makespan
-        (reference: candidate schedules loop)."""
+        among memory-feasible candidates (reference: candidate schedules
+        loop + DevState OOM state, pjrt/task_scheduler.h:86-180). Wider
+        1F1B windows trade peak activation memory for bubble time; when a
+        window's simulated peak exceeds ``mem_limit_bytes`` it is rejected,
+        and if every candidate is infeasible the search walks *narrower*
+        windows (fewer in-flight micros) until one fits. Only when no
+        window fits at all is the min-peak schedule returned, flagged
+        ``memory_feasible=False``."""
         env = ServiceEnv.get()
-        candidates = []
         windows = [self.micro_limit]
         for delta in range(1, env.group_sched_count):
             w = self.micro_limit + delta
             windows.append(w)
-        best = None
-        for w in windows[: env.group_sched_count]:
-            r = self._simulate(w)
-            if best is None or r.makespan < best.makespan:
-                best = r
-        return best
+        results = [self._simulate(w) for w in windows[: env.group_sched_count]]
+        if self.mem_limit is not None:
+            for r in results:
+                r.memory_feasible = (
+                    max(r.peak_bytes.values(), default=0.0) <= self.mem_limit)
+            feasible = [r for r in results if r.memory_feasible]
+            if not feasible:
+                for w in range(self.micro_limit - 1, 0, -1):
+                    r = self._simulate(w)
+                    r.memory_feasible = (
+                        max(r.peak_bytes.values(), default=0.0)
+                        <= self.mem_limit)
+                    results.append(r)
+                    if r.memory_feasible:
+                        feasible = [r]
+                        break
+            if feasible:
+                return min(feasible, key=lambda r: r.makespan)
+            # Nothing fits: surface the least-bad schedule, flagged.
+            return min(results,
+                       key=lambda r: max(r.peak_bytes.values(), default=0.0))
+        return min(results, key=lambda r: r.makespan)
 
     def _simulate(self, window: int, use_native: Optional[bool] = None
                   ) -> ScheduleResult:
@@ -206,16 +233,28 @@ class TaskScheduler:
                               peak, bubble)
 
     def _simulate_py(self, window: int) -> ScheduleResult:
+        """Event-driven simulation (reference: ClusterState::ScheduleNextTask
+        + MarkTaskDoneByTime, pjrt/task_scheduler.cc): a task STARTS only
+        when every parent has *finished in simulated time* and its devices
+        are free — not merely when parents have been scheduled. That
+        time-gating is what creates run-ahead: while micro 0's backward is
+        still in flight downstream, stage 0's device is free and starts
+        micro 1's forward. The 1F1B window is a hard admission gate on that
+        run-ahead (fwd of a new micro may not start while ``window`` micros
+        are in flight on its stage), which is exactly the bubble-vs-peak-
+        memory trade the mem_limit search explores."""
         dag = self.dag
         indeg = {n.id: len(n.parents) for n in dag.nodes}
         dev_free: Dict[int, float] = {}
+        for n in dag.nodes:
+            for d in n.device_group:
+                dev_free.setdefault(d, 0.0)
         task_finish: Dict[int, float] = {}
         start: Dict[int, float] = {}
         order: List[int] = []
         per_device: Dict[Tuple[int, ...], List[int]] = {}
-        # in-flight micro-batches per stage (fwd started, bwd not finished)
+        # in-flight micro-batches per stage: fwd STARTED, bwd not FINISHED.
         inflight: Dict[int, set] = {}
-        ready: List[Tuple[Tuple, int]] = []
 
         def is_bwd(n: TaskNode) -> bool:
             return n.task_type == TaskType.COMPUTE and "bwd" in n.name
@@ -224,53 +263,69 @@ class TaskScheduler:
             return n.task_type == TaskType.COMPUTE and "fwd" in n.name
 
         def priority(n: TaskNode) -> Tuple:
-            # 1F1B: backward tasks outrank forwards when the stage window is
-            # full; otherwise lower micro index first, deeper stage first for
-            # bwd (drain), shallower first for fwd (fill).
-            stage_full = (is_fwd(n) and window > 0 and
-                          len(inflight.get(n.stage, ())) >= window)
-            cls = 1 if stage_full else 0
+            # Among startable tasks: lower micro first, backward before
+            # forward (drain beats fill at equal micro), stable by id.
             bwd_bonus = 0 if is_bwd(n) else 1
-            return (cls, n.micro if n.micro >= 0 else 0, bwd_bonus, n.id)
+            return (n.micro if n.micro >= 0 else 0, bwd_bonus, n.id)
 
-        for n in dag.nodes:
-            if indeg[n.id] == 0:
-                heapq.heappush(ready, (priority(n), n.id))
-
+        # pool: time-ready tasks (all parents finished) not yet started.
+        pool: List[int] = [n.id for n in dag.nodes if indeg[n.id] == 0]
+        events: List[Tuple[float, int]] = []   # (finish_time, task id)
         sim_busy: Dict[int, float] = {}
-        while ready:
-            # Re-sort lazily: pop best currently-valid entry.
-            _, tid = heapq.heappop(ready)
-            n = dag.node(tid)
-            pr = priority(n)
-            if ready and pr > ready[0][0]:
-                heapq.heappush(ready, (pr, tid))
-                _, tid = heapq.heappop(ready)
+        t_now = 0.0
+
+        def try_start() -> bool:
+            best = None
+            for tid in pool:
                 n = dag.node(tid)
-            t_ready = max((task_finish[p] for p in n.parents), default=0.0)
-            t_dev = max((dev_free.get(d, 0.0) for d in n.device_group),
-                        default=0.0)
-            t0 = max(t_ready, t_dev)
+                if any(dev_free[d] > t_now for d in n.device_group):
+                    continue
+                if (is_fwd(n) and window > 0 and n.micro not in
+                        inflight.get(n.stage, ()) and
+                        len(inflight.get(n.stage, ())) >= window):
+                    continue        # 1F1B gate: stage window full
+                pr = priority(n)
+                if best is None or pr < best[0]:
+                    best = (pr, tid)
+            if best is None:
+                return False
+            tid = best[1]
+            pool.remove(tid)
+            n = dag.node(tid)
             dur = self.task_time(n)
-            start[n.id] = t0
-            task_finish[n.id] = t0 + dur
-            order.append(n.id)
-            per_device.setdefault(tuple(n.device_group), []).append(n.id)
+            start[tid] = t_now
+            fin = t_now + dur
+            order.append(tid)
+            per_device.setdefault(tuple(n.device_group), []).append(tid)
             for d in n.device_group:
-                dev_free[d] = t0 + dur
+                dev_free[d] = fin
                 sim_busy[d] = sim_busy.get(d, 0.0) + (
                     dur if n.task_type == TaskType.COMPUTE else 0.0)
             if is_fwd(n):
                 inflight.setdefault(n.stage, set()).add(n.micro)
-            if is_bwd(n):
-                inflight.setdefault(n.stage, set()).discard(n.micro)
-            for c in n.children:
-                indeg[c] -= 1
-                if indeg[c] == 0:
-                    cn = dag.node(c)
-                    heapq.heappush(ready, (priority(cn), c))
-        if len(order) != len(dag.nodes):
-            raise RuntimeError("schedule deadlock: DAG not fully drained")
+            heapq.heappush(events, (fin, tid))
+            return True
+
+        while len(order) < len(dag.nodes):
+            while try_start():
+                pass
+            if not events:
+                raise RuntimeError("schedule deadlock: DAG not fully drained")
+            # Advance to the next completion instant; process every event at
+            # that time before starting more work (ties by id via the heap).
+            t_now, tid = heapq.heappop(events)
+            finished = [tid]
+            while events and events[0][0] == t_now:
+                finished.append(heapq.heappop(events)[1])
+            for tid in finished:
+                n = dag.node(tid)
+                task_finish[tid] = t_now
+                if is_bwd(n):
+                    inflight.setdefault(n.stage, set()).discard(n.micro)
+                for c in n.children:
+                    indeg[c] -= 1
+                    if indeg[c] == 0:
+                        pool.append(c)
 
         makespan = max(task_finish.values(), default=0.0)
         peak = self._memory_account(order)
