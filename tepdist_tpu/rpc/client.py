@@ -151,9 +151,15 @@ class TepdistClient:
              "lazy": lazy}))
 
     def do_remote_restore(self, global_step: int = -1,
-                          lazy: bool = False) -> None:
-        self.stub.call("DoRemoteRestore", protocol.pack(
-            {"global_step": global_step, "lazy": lazy}))
+                          lazy: bool = False,
+                          all_shards: bool = False) -> int:
+        """Returns the restored global step (-1 when lazy: the restore is
+        latched and consumed on the next ExecutePlan)."""
+        resp = self.stub.call("DoRemoteRestore", protocol.pack(
+            {"global_step": global_step, "lazy": lazy,
+             "all_shards": all_shards}))
+        header, _ = protocol.unpack(resp)
+        return int(header.get("global_step", -1))
 
     def close(self) -> None:
         self.stub.close()

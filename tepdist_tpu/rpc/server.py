@@ -404,12 +404,13 @@ class TepdistServicer:
 
     def DoRemoteRestore(self, request: bytes, context=None) -> bytes:
         header, _ = protocol.unpack(request)
-        opts = {"global_step": int(header.get("global_step", -1))}
+        opts = {"global_step": int(header.get("global_step", -1)),
+                "all_shards": bool(header.get("all_shards"))}
         if header.get("lazy"):
             self.ckpt_opts["restore"] = opts
-        else:
-            self._do_restore(opts)
-        return protocol.pack({"ok": True})
+            return protocol.pack({"ok": True})
+        self._do_restore(opts)
+        return protocol.pack({"ok": True, "global_step": self.global_step})
 
     def _do_save(self, opts) -> None:
         from tepdist_tpu.runtime.checkpoint import CheckpointUtil
@@ -436,8 +437,14 @@ class TepdistServicer:
 
     def _do_restore(self, opts) -> None:
         from tepdist_tpu.runtime.checkpoint import CheckpointUtil
-        data, step = CheckpointUtil(self.ckpt_dir).restore(
-            opts.get("global_step", -1), worker_id=self.task_index)
+        util = CheckpointUtil(self.ckpt_dir)
+        if opts.get("all_shards"):
+            # Elastic re-dispatch: this worker may have adopted stages a
+            # dead worker owned — read the union of every worker's files.
+            data, step = util.restore_union(opts.get("global_step", -1))
+        else:
+            data, step = util.restore(opts.get("global_step", -1),
+                                      worker_id=self.task_index)
         with self._lock:
             opt_states: Dict[int, Dict[int, Any]] = {}
             for k, v in data.items():

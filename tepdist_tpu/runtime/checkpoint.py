@@ -198,6 +198,29 @@ class CheckpointUtil:
         out.update(self._assemble_shards(step_dir, preloaded={local: data}))
         return out, step
 
+    def restore_union(self, step: int = -1) -> Tuple[Dict[str, np.ndarray],
+                                                     int]:
+        """Merge EVERY worker's files for one step: whole entries from all
+        shard files plus assembled multi-host shards. This is the elastic
+        re-dispatch read path — a surviving worker adopting a dead worker's
+        stages restores state the dead worker saved (requires the shared
+        checkpoint directory the multi-worker save contract already
+        assumes)."""
+        step = self._resolve_step(step)
+        step_dir = os.path.join(self.dir, f"step_{step:012d}")
+        out: Dict[str, np.ndarray] = {}
+        preloaded: Dict[str, Dict[str, np.ndarray]] = {}
+        for fn in sorted(os.listdir(step_dir)):
+            if not (fn.startswith("worker") and fn.endswith(".npz")):
+                continue
+            data = self._load_npz(os.path.join(step_dir, fn))
+            preloaded[fn] = data
+            for k, v in data.items():
+                if "::shard" not in k:
+                    out[k] = v
+        out.update(self._assemble_shards(step_dir, preloaded=preloaded))
+        return out, step
+
     def _assemble_shards(self, step_dir: str,
                          preloaded: Optional[Dict[str, Dict[str, np.ndarray]]]
                          = None) -> Dict[str, np.ndarray]:

@@ -354,3 +354,86 @@ def test_four_stages_over_two_workers(two_workers):
         l, p, s = ref_step(p, s, x, y)
         ref.append(float(l))
     np.testing.assert_allclose(losses, ref, rtol=1e-4)
+
+
+def test_auto_redispatch_onto_shrunken_cluster(tmp_path):
+    """VERDICT r1 item 8: kill one of two workers; the ELASTIC session
+    detects the death on the next step, rebuilds WorkerPlans over the
+    single survivor (which adopts the dead worker's stages), restores the
+    union of all checkpoint shards, and retries — NO manual resume call.
+    The loss trajectory equals an uninterrupted run."""
+
+    def loss_fn(params, x, y):
+        h = x
+        for i in range(4):
+            h = jnp.tanh(h @ params[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    keys = jax.random.split(k, 6)
+    params = {f"w{i}": jax.random.normal(keys[i], (32, 32)) * 0.3
+              for i in range(4)}
+    x = jax.random.normal(keys[4], (16, 32))
+    y = jax.random.normal(keys[5], (16, 32))
+    prog = plan_pipeline(loss_fn, 2, 2, params, x, y)
+    tx = optax.adam(1e-2)  # stateful: moments must survive recovery
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["TEPDIST_CKPT_DIR"] = str(tmp_path)  # SHARED ckpt dir
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def spawn(task_index, port):
+        return subprocess.Popen(
+            [sys.executable, "-m", "tepdist_tpu.rpc.server",
+             "--port", str(port), "--platform", "cpu",
+             "--task_index", str(task_index)],
+            env=env, cwd=root,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    p0_port, p1_port = _free_port(), _free_port()
+    w0, w1 = spawn(0, p0_port), spawn(1, p1_port)
+    from tepdist_tpu.rpc.client import TepdistClient
+    for p in (p0_port, p1_port):
+        c = TepdistClient(f"127.0.0.1:{p}")
+        c.wait_ready(60)
+        c.close()
+    try:
+        cluster = ClusterSpec([
+            WorkerSpec("127.0.0.1", p0_port, [0], task_index=0),
+            WorkerSpec("127.0.0.1", p1_port, [0], task_index=1),
+        ])
+        sess = DistributedPipelineSession(prog, cluster, optimizer=tx,
+                                          elastic=True, autosave_every=1)
+        sess.load_variables(params)
+        losses = [sess.step(x, y) for _ in range(2)]
+
+        # Worker 1 dies. No replacement, no resume() — just keep stepping.
+        w1.send_signal(signal.SIGKILL)
+        w1.wait()
+        losses += [sess.step(x, y) for _ in range(2)]
+        assert sess.cluster.num_workers == 1  # really re-dispatched
+        got = sess.fetch_variables()
+        sess.close()
+    finally:
+        for w in (w0, w1):
+            w.send_signal(signal.SIGKILL)
+            w.wait()
+
+    def apply_fn(pp, ss, g):
+        u, ss = tx.update(g, ss, pp)
+        return optax.apply_updates(pp, u), ss
+
+    ref_step = jax.jit(prog.reference_step(apply_fn))
+    p, s = params, tx.init(params)
+    ref = []
+    for _ in range(4):
+        l, p, s = ref_step(p, s, x, y)
+        ref.append(float(l))
+    np.testing.assert_allclose(losses, ref, rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
+        got, jax.device_get(p))
