@@ -54,6 +54,14 @@ def _build_primitive_registry() -> Dict[str, Any]:
         modules.append(m11)
     except ImportError:  # pragma: no cover - internal layout moved
         pass
+    try:
+        import jax._src.shard_map as m12   # shard_map_p: the SPMD wrapper
+        modules.append(m12)
+        import jax._src.pjit as m13        # sharding_constraint_p etc.
+        modules.append(m13)
+        modules.append(_core)              # pvary_p (vma adjustment)
+    except ImportError:  # pragma: no cover - internal layout moved
+        pass
     for mod in modules:
         for name in dir(mod):
             obj = getattr(mod, name, None)
@@ -96,6 +104,11 @@ _ENUMS = {k: v for k, v in _ENUMS.items() if v is not None}
 
 def _enc_array(x: np.ndarray) -> dict:
     x = np.asarray(x)
+    if x.dtype == jax.dtypes.float0:
+        # float0 (symbolic-zero cotangents for integer primals) has
+        # itemsize 0 — there are no bytes to ship, only the shape.
+        return {"t": "ndarray", "dtype": "float0", "shape": list(x.shape),
+                "data": ""}
     return {
         "t": "ndarray",
         "dtype": x.dtype.name,
@@ -105,6 +118,8 @@ def _enc_array(x: np.ndarray) -> dict:
 
 
 def _dec_array(d: dict) -> np.ndarray:
+    if d["dtype"] == "float0":
+        return np.zeros(d["shape"], dtype=jax.dtypes.float0)
     buf = base64.b64decode(d["data"])
     return np.frombuffer(buf, dtype=np.dtype(d["dtype"])).reshape(d["shape"])
 
@@ -150,6 +165,29 @@ def encode_value(v: Any) -> Any:
     if (type(v).__name__ in ("Mesh", "AbstractMesh")
             and not getattr(v, "axis_names", None)):
         return {"t": "empty_mesh"}  # trace-context mesh placeholder
+    if type(v).__name__ in ("Mesh", "AbstractMesh"):
+        # shard_map's mesh: axis structure crosses the wire; the RECEIVER
+        # materialises a concrete Mesh over its own devices (device handles
+        # are process-local, exactly like the reference's device_assignment
+        # re-resolution on the server, virtual_client.cc). AbstractMesh
+        # (e.g. the Manual-typed mesh inside sharding_constraint params of
+        # a shard_map body) stays abstract.
+        return {"t": "mesh",
+                "abstract": type(v).__name__ == "AbstractMesh",
+                "axis_names": [str(n) for n in v.axis_names],
+                "axis_types": [t.name for t in v.axis_types],
+                "shape": [int(s) for s in v.axis_sizes]}
+    if type(v).__name__ == "NamedSharding":
+        return {"t": "named_sharding",
+                "mesh": encode_value(v.mesh),
+                "spec": encode_value(v.spec)}
+    if type(v).__name__ == "PartitionSpec":
+        return {"t": "pspec",
+                "v": [None if e is None else
+                      list(e) if isinstance(e, tuple) else str(e)
+                      for e in tuple(v)]}
+    if isinstance(v, frozenset):
+        return {"t": "frozenset", "v": sorted(encode_value(x) for x in v)}
     raise TypeError(
         f"cannot serialize param value of type {type(v).__name__}: {v!r}")
 
@@ -187,6 +225,37 @@ def decode_value(v: Any) -> Any:
     if t == "empty_mesh":
         from jax.sharding import AbstractMesh
         return AbstractMesh((), ())
+    if t == "mesh":
+        from jax._src.mesh import AxisType
+        from jax.sharding import Mesh
+        types = tuple(AxisType[n] for n in v.get("axis_types", [])) or None
+        n = 1
+        for s in v["shape"]:
+            n *= s
+        devs = jax.devices()
+        if len(devs) < n:
+            raise ValueError(
+                f"received mesh needs {n} devices, host has {len(devs)}")
+        mesh = Mesh(np.array(devs[:n]).reshape(v["shape"]),
+                    axis_names=tuple(v["axis_names"]),
+                    axis_types=types)
+        if v.get("abstract"):
+            # Derive from the concrete local mesh so device_kind/num_cores
+            # match the avals the receiver's own trace machinery produces
+            # (AbstractMesh equality includes them).
+            return mesh.abstract_mesh
+        return mesh
+    if t == "named_sharding":
+        from jax.sharding import NamedSharding
+        return NamedSharding(decode_value(v["mesh"]),
+                             decode_value(v["spec"]))
+    if t == "pspec":
+        from jax.sharding import PartitionSpec
+        return PartitionSpec(*[
+            None if e is None else tuple(e) if isinstance(e, list) else e
+            for e in v["v"]])
+    if t == "frozenset":
+        return frozenset(decode_value(x) for x in v["v"])
     raise TypeError(f"unknown tag {t}")
 
 
@@ -195,19 +264,36 @@ def decode_value(v: Any) -> Any:
 # --------------------------------------------------------------------------
 
 def _aval_dict(aval) -> dict:
-    return {
+    d = {
         "shape": list(aval.shape),
         "dtype": (np.dtype(aval.dtype).name
                   if aval.dtype != jax.dtypes.float0 else "float0"),
         "weak_type": bool(getattr(aval, "weak_type", False)),
     }
+    vma = getattr(aval, "vma", None)
+    if vma:
+        # Varying-manual-axes typing inside shard_map bodies: without it
+        # the rebuilt jaxpr fails check_vma on bind.
+        d["vma"] = sorted(str(a) for a in vma)
+    shd = getattr(aval, "sharding", None)
+    if shd is not None and not getattr(shd.mesh, "empty", True):
+        # An aval carrying vma MUST also carry the sharding whose (manual
+        # abstract) mesh licenses those axes — get_vma rejects vma against
+        # an empty mesh.
+        d["sharding"] = encode_value(shd)
+    return d
 
 
 def _make_aval(d: dict):
     if d["dtype"] == "float0":
         return _core.ShapedArray(tuple(d["shape"]), jax.dtypes.float0)
+    kw = {}
+    if d.get("sharding"):
+        kw["sharding"] = decode_value(d["sharding"])
+    if d.get("vma"):
+        kw["vma"] = frozenset(d["vma"])
     return _core.ShapedArray(tuple(d["shape"]), np.dtype(d["dtype"]),
-                             weak_type=d.get("weak_type", False))
+                             weak_type=d.get("weak_type", False), **kw)
 
 
 def _encode_jaxpr(jaxpr) -> dict:
@@ -232,12 +318,20 @@ def _encode_jaxpr(jaxpr) -> dict:
                 outvars.append({"k": "drop", "aval": _aval_dict(ov.aval)})
             else:
                 outvars.append(enc_atom(ov))
-        eqns.append({
+        e = {
             "prim": eqn.primitive.name,
             "invars": [enc_atom(a) for a in eqn.invars],
             "outvars": outvars,
             "params": {k: encode_value(v) for k, v in eqn.params.items()},
-        })
+        }
+        # Equations traced inside shard_map record the ambient manual mesh
+        # in their JaxprEqnContext; vma checking at re-bind (scan carry
+        # harmonisation etc.) consults it, so it must cross the wire.
+        ctx_mesh = getattr(getattr(eqn, "ctx", None), "cur_abstract_mesh",
+                           None)
+        if ctx_mesh is not None and getattr(ctx_mesh, "axis_names", ()):
+            e["ctx_mesh"] = encode_value(ctx_mesh)
+        eqns.append(e)
     return {
         "constvars": [enc_atom(v) for v in jaxpr.constvars],
         "invars": [enc_atom(v) for v in jaxpr.invars],
@@ -279,8 +373,16 @@ def _decode_jaxpr_struct(d: dict):
             else:
                 outv.append(dec_atom(a))
         params = {k: decode_value(v) for k, v in e["params"].items()}
+        ctx = None
+        if "ctx_mesh" in e:
+            import jax as _jax
+            ctx = _core.JaxprEqnContext(
+                None, bool(_jax.config.jax_threefry_partitionable))
+            # The constructor snapshots the AMBIENT abstract mesh; restore
+            # the recorded one (the manual mesh this eqn was traced under).
+            ctx.cur_abstract_mesh = decode_value(e["ctx_mesh"])
         eqns.append(_core.new_jaxpr_eqn(
-            inv, outv, prim, params, effects=_core.no_effects))
+            inv, outv, prim, params, effects=_core.no_effects, ctx=ctx))
     outvars = [dec_atom(a) for a in d["outvars"]]
     import warnings
     with warnings.catch_warnings():

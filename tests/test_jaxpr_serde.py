@@ -235,3 +235,42 @@ def test_registry_covers_model_zoo_primitives():
             except KeyError:
                 missing.add(eqn.primitive.name)
     assert not missing, f"registry missing: {sorted(missing)}"
+
+
+def test_shard_map_round_trip(devices):
+    """VERDICT r1 item 5: shard_map eqns ship over the wire — mesh axis
+    structure, PartitionSpecs, manual-mesh eqn contexts, and vma-typed
+    avals all reconstruct, and the rebuilt jaxpr executes identically.
+    Ring attention (ppermute + scan) and Ulysses (all-to-alls) are the
+    long-context payloads this exists for."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tepdist_tpu.ops.ring_attention import ring_attention
+    from tepdist_tpu.ops.ulysses import ulysses_attention
+    from tepdist_tpu.rpc.jaxpr_serde import (
+        deserialize_closed_jaxpr,
+        serialize_closed_jaxpr,
+    )
+
+    mesh = Mesh(np.array(devices[:4]), axis_names=("seq",))
+    B, H, T, D = 2, 4, 32, 16
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (B, H, T, D))
+    k = jax.random.normal(k2, (B, H, T, D))
+    v = jax.random.normal(k3, (B, H, T, D))
+
+    for op in (ring_attention, ulysses_attention):
+        def f(q, k, v):
+            return jnp.sum(op(q, k, v, mesh))
+
+        for make in (lambda: jax.make_jaxpr(f)(q, k, v),
+                     lambda: jax.make_jaxpr(jax.grad(f))(q, k, v)):
+            closed = make()
+            rt = deserialize_closed_jaxpr(
+                serialize_closed_jaxpr(closed, inline=False))
+            a = jax.core.eval_jaxpr(closed.jaxpr, closed.consts, q, k, v)
+            b = jax.core.eval_jaxpr(rt.jaxpr, rt.consts, q, k, v)
+            for x, y in zip(a, b):
+                np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                           rtol=1e-5)
