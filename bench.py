@@ -40,20 +40,25 @@ V5E_PEAK_FLOPS = 197e12  # bf16
 
 def _vs_baseline(metric: str, value: float, extra: dict | None = None
                  ) -> float:
-    """Ratio against the stored baseline; first run records it."""
+    """Ratio against the stored baseline; first run records it. A corrupt
+    baseline file is never overwritten (other metrics' baselines would be
+    lost) — the current value just serves as its own baseline."""
     data = {}
     if os.path.exists(BASELINE_FILE):
         try:
             data = json.load(open(BASELINE_FILE))
         except Exception:
-            data = {}
+            return 1.0
     baseline = data.get(metric)
     if baseline is None:
         data[metric] = value
         for k, v in (extra or {}).items():
             data[f"{metric}_{k}"] = v
         try:
-            json.dump(data, open(BASELINE_FILE, "w"), indent=1)
+            tmp = f"{BASELINE_FILE}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=1)
+            os.replace(tmp, BASELINE_FILE)
         except Exception:
             pass
         baseline = value

@@ -46,6 +46,10 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
      "(0 = auto: ICI intra-worker, DCN cross-worker; reference fixed 16)"),
     ("ILP_TIME_LIMIT", float, 5.0, "ILP solver time limit (s)"),
     ("ILP_NUM_THREADS", int, 0, "compat: scipy/HiGHS milp is single-threaded"),
+    ("GLUE_WALK_HOPS", int, 64, "max glue-chain depth when translating comm "
+     "edge demands back to their producers (CostSpmdStrategy._collect_edges; "
+     "the walk is memoized, so the cap only guards recursion depth — edges "
+     "past it are dropped from the ILP objective with a warning)"),
     ("FAKE_INPUT", bool, False, "reuse first batch forever (benchmark mode)"),
     # Accepted for config compatibility with the reference; no-ops on TPU
     # (the mechanism they tune does not exist here — see help text).

@@ -130,6 +130,10 @@ class GraphStrategy:
     # Attention motifs to rewrite into ring attention (seq axis only;
     # parallel/attention_motif.py). The SPMD transform consumes these.
     motifs: Optional[List] = None
+    # Rule-mode reshard decisions (reference: FastSpmdStrategy's reshard
+    # Solution edges): node id -> {operand pos: (produced, demanded)}.
+    # GSPMD materialises the conversions; the Evaluator prices them.
+    reshard_edges: Optional[Dict[int, Dict[int, Tuple]]] = None
 
 
 class CostSpmdStrategy:
@@ -164,8 +168,9 @@ class CostSpmdStrategy:
             log.warning(
                 "CostSpmdStrategy axis=%s: %d comm edges dropped by the "
                 "%d-hop glue-walk cap (their cost is not in the ILP "
-                "objective — deep graphs may be mispriced)",
-                self.axis, self._edges_dropped, 12)
+                "objective — deep graphs may be mispriced; raise "
+                "GLUE_WALK_HOPS)",
+                self.axis, self._edges_dropped, self.env.glue_walk_hops)
         log.info(
             "CostSpmdStrategy axis=%s n=%d cones=%d status=%s cost=%.3e (%.2fs)",
             self.axis, self.n, len(cones), status, gs.total_cost,
@@ -298,12 +303,15 @@ class CostSpmdStrategy:
                     cone.strategies.append(cs)
 
     # ------------------------------------------------------------------
-    def _collect_edges(self, v: Var, want: DimStrategy, hops: int = 12
+    def _collect_edges(self, v: Var, want: DimStrategy,
+                       hops: Optional[int] = None
                        ) -> List[Tuple[Var, DimStrategy]]:
         """Walk back through glue nodes translating the demanded strategy,
         collecting EVERY terminal that is a cone-produced var or a graph
         input. Dead ends (locally generated values: broadcasts, iota, rng)
         contribute no edge — they are shard-local by construction."""
+        if hops is None:
+            hops = self.env.glue_walk_hops
         out: List[Tuple[Var, DimStrategy]] = []
         seen = set()
 

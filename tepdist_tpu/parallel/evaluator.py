@@ -150,7 +150,20 @@ class Evaluator:
                         coll_t += cost_factor * PerfUtils.all_reduce_cost(
                             aval_bytes(ov.aval), gs.num_splits, self.spec)
                         break
-            coll_t += self._reshard_time(graph, gs)
+            if gs.reshard_edges:
+                # Rule-mode plans record their reshard decisions explicitly
+                # (FastSpmdStrategy Solution edges) — price those directly.
+                for nid, posmap in gs.reshard_edges.items():
+                    node = graph.nodes[nid]
+                    for pos, (src, want) in posmap.items():
+                        if src.partial:
+                            continue   # partial->psum priced above already
+                        a = node.invars[pos]
+                        coll_t += transition_cost(
+                            src, want, aval_bytes(a.aval), gs.num_splits,
+                            self.spec)
+            else:
+                coll_t += self._reshard_time(graph, gs)
 
         # Memory: parameters (sharded where split) + activation peak.
         from tepdist_tpu.parallel.sync_free import (
