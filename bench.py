@@ -91,9 +91,11 @@ def bench_gpt2_15b() -> dict:
     from tepdist_tpu.optim import adamw_bf16
     from tepdist_tpu.train import plan_training
 
-    cfg = dataclasses.replace(gpt2.CONFIGS["1.5B"], attn="flash", remat=True)
+    cfg = dataclasses.replace(gpt2.CONFIGS["1.5B"], attn="flash", remat=True,
+                              loss_chunk=512)
     n_params = gpt2.num_params(cfg)
-    batch, seq, micro, steps = 8, 1024, 4, 3
+    batch, seq, micro, steps = 8, 1024, int(os.environ.get(
+        "BENCH_15B_MICRO", "4")), 3
 
     params = gpt2.stacked_init_params(cfg, jax.random.PRNGKey(0))
     tokens = gpt2.fake_batch(cfg, batch, seq)

@@ -35,6 +35,12 @@ class GPT2Config:
     # Rematerialise each transformer block in backward (jax.checkpoint):
     # trades recompute FLOPs for activation HBM — how the big configs fit.
     remat: bool = False
+    # Chunked cross-entropy: compute logits/logsumexp over `loss_chunk`
+    # tokens at a time under jax.checkpoint, so the [B*T, vocab] fp32
+    # logits tensor never materialises (peak loss memory drops from
+    # B*T*V*4 to chunk*V*4 bytes — the big configs' other memory wall).
+    # 0 = dense. Falls back to dense when B*T isn't divisible.
+    loss_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -141,8 +147,8 @@ def transformer_block(block, x, cfg: GPT2Config, attn_impl=None):
     return x
 
 
-def forward(params, tokens, cfg: GPT2Config, attn_impl=None):
-    """tokens: int32 [B, T] -> logits [B, T, vocab] (fp32)."""
+def hidden_states(params, tokens, cfg: GPT2Config, attn_impl=None):
+    """tokens: int32 [B, T] -> final (ln_f-normalised) hidden [B, T, D]."""
     B, T = tokens.shape
     x = params["wte"][tokens] + params["wpe"][:T]
     x = x.astype(cfg.dtype)
@@ -155,17 +161,54 @@ def forward(params, tokens, cfg: GPT2Config, attn_impl=None):
     else:
         for i in range(cfg.n_layer):
             x = block_fn(params[f"h{i}"], x, cfg, attn_impl)
-    x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
+    return _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
+
+
+def forward(params, tokens, cfg: GPT2Config, attn_impl=None):
+    """tokens: int32 [B, T] -> logits [B, T, vocab] (fp32)."""
+    x = hidden_states(params, tokens, cfg, attn_impl)
     return (x @ params["wte"].T).astype(jnp.float32)
+
+
+def _ce_from_hidden(x, wte, targets, cfg: GPT2Config):
+    """Cross entropy from final hidden states, optionally chunked.
+
+    Dense path: logits = x @ wte.T in one [B, T, V] fp32 tensor. Chunked
+    path (cfg.loss_chunk > 0): lax.scan over token chunks with the chunk
+    body checkpointed — forward AND backward hold only [chunk, V] logits
+    at a time; the backward recomputes each chunk's logits from the saved
+    [chunk, D] hidden slice. Summation order changes (per-chunk partial
+    sums), so results match the dense path to float tolerance, not
+    bit-exactly."""
+    B, T, D = x.shape
+    chunk = cfg.loss_chunk
+    n_tokens = B * T
+    if chunk <= 0 or n_tokens % chunk:
+        logits = (x @ wte.T).astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(logz - gold)
+
+    xf = x.reshape(n_tokens // chunk, chunk, D)
+    tf = targets.reshape(n_tokens // chunk, chunk)
+
+    @jax.checkpoint
+    def body(acc, inp):
+        xc, tc = inp
+        logits = (xc @ wte.T).astype(jnp.float32)       # [chunk, V]
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        return acc + jnp.sum(logz - gold), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xf, tf))
+    return total / n_tokens
 
 
 def loss_fn(params, tokens, cfg: GPT2Config, attn_impl=None):
     """Next-token cross entropy over shifted tokens (reference GPT2 LM loss)."""
-    logits = forward(params, tokens[:, :-1], cfg, attn_impl)
-    targets = tokens[:, 1:]
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    x = hidden_states(params, tokens[:, :-1], cfg, attn_impl)
+    return _ce_from_hidden(x, params["wte"], tokens[:, 1:], cfg)
 
 
 # --------------------------------------------------------------------------
@@ -183,9 +226,9 @@ def stacked_init_params(cfg: GPT2Config, key):
     return out
 
 
-def forward_stacked(params, tokens, cfg: GPT2Config, attn_impl=None):
-    """tokens: int32 [B, T] -> logits [B, T, vocab] (fp32), scanning the
-    stacked block params."""
+def hidden_states_stacked(params, tokens, cfg: GPT2Config, attn_impl=None):
+    """tokens: int32 [B, T] -> final hidden [B, T, D], scanning the
+    stacked block params (one layer's HLO traced once)."""
     B, T = tokens.shape
     x = params["wte"][tokens] + params["wpe"][:T]
     x = x.astype(cfg.dtype)
@@ -196,16 +239,19 @@ def forward_stacked(params, tokens, cfg: GPT2Config, attn_impl=None):
     if cfg.remat:
         body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, params["blocks"])
-    x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
+    return _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
+
+
+def forward_stacked(params, tokens, cfg: GPT2Config, attn_impl=None):
+    """tokens: int32 [B, T] -> logits [B, T, vocab] (fp32), scanning the
+    stacked block params."""
+    x = hidden_states_stacked(params, tokens, cfg, attn_impl)
     return (x @ params["wte"].T).astype(jnp.float32)
 
 
 def loss_fn_stacked(params, tokens, cfg: GPT2Config, attn_impl=None):
-    logits = forward_stacked(params, tokens[:, :-1], cfg, attn_impl)
-    targets = tokens[:, 1:]
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    x = hidden_states_stacked(params, tokens[:, :-1], cfg, attn_impl)
+    return _ce_from_hidden(x, params["wte"], tokens[:, 1:], cfg)
 
 
 # --------------------------------------------------------------------------
@@ -283,10 +329,7 @@ def pipelined_loss_fn(params, stacked_blocks, tokens, cfg: GPT2Config,
     y_micro = pipelined(stacked_blocks, x_micro)
     y = y_micro.reshape(B, T, cfg.n_embd)
     y = _layer_norm(y, params["ln_f_g"], params["ln_f_b"])
-    logits = (y @ params["wte"].T).astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    return _ce_from_hidden(y, params["wte"], targets, cfg)
 
 
 def fake_batch(cfg: GPT2Config, batch_size: int, seq_len: Optional[int] = None,

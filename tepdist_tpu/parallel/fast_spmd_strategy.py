@@ -59,8 +59,11 @@ class FastSpmdStrategy:
         changed = True
         sweeps = 0
         # Each sweep either adds at least one var value or terminates, so
-        # the loop is bounded without any per-node revisit guard.
-        while changed and sweeps <= len(self.graph.invars) + len(nodes) + 2:
+        # the worst-case sweep count is the number of assignable variables
+        # (invars + constvars + every eqn output).
+        max_sweeps = (len(self.graph.invars) + len(self.graph.constvars)
+                      + sum(len(n.outvars) for n in nodes) + 2)
+        while changed and sweeps <= max_sweeps:
             changed = False
             sweeps += 1
             reshards.clear()    # re-derived each sweep from current values
@@ -72,7 +75,7 @@ class FastSpmdStrategy:
                 if not known:
                     continue
                 r = StrategyUtil.forward_infer(node.eqn, known, self.n)
-                if r is None:
+                if r is None and len(known) > 1:
                     # Operand strategies conflict at this op: keep the
                     # lowest operand position's view (deterministic) and
                     # let the others become reshard edges below.

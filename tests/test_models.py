@@ -301,3 +301,39 @@ def test_llama_model_axis_plan(devices):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
         g, g_ref)
+
+
+def test_gpt2_chunked_cross_entropy_matches_dense(devices):
+    """cfg.loss_chunk streams the vocab projection in checkpointed chunks
+    (the [B*T, V] fp32 logits tensor never materialises). Loss and grads
+    must match the dense path to float tolerance (summation order
+    changes), in both the per-layer and stacked forms; a non-dividing
+    chunk falls back to dense."""
+    import dataclasses
+
+    from tepdist_tpu.models import gpt2
+
+    cfg = gpt2.CONFIGS["test"]
+    cfg_c = dataclasses.replace(cfg, loss_chunk=31)   # 4*31 tokens/chunk=4
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg, 4, 31)
+
+    l_dense, g_dense = jax.value_and_grad(
+        lambda p: gpt2.loss_fn(p, tokens, cfg))(params)
+    l_chunk, g_chunk = jax.value_and_grad(
+        lambda p: gpt2.loss_fn(p, tokens, cfg_c))(params)
+    np.testing.assert_allclose(float(l_chunk), float(l_dense), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        g_chunk, g_dense)
+
+    sp = gpt2.stacked_init_params(cfg, jax.random.PRNGKey(0))
+    l_s = gpt2.loss_fn_stacked(sp, tokens, cfg)
+    l_sc = gpt2.loss_fn_stacked(sp, tokens, cfg_c)
+    np.testing.assert_allclose(float(l_sc), float(l_s), rtol=1e-5)
+
+    # Non-dividing chunk: silently dense, same value.
+    cfg_nd = dataclasses.replace(cfg, loss_chunk=33)
+    l_nd = gpt2.loss_fn(params, tokens, cfg_nd)
+    np.testing.assert_allclose(float(l_nd), float(l_dense), rtol=1e-6)
