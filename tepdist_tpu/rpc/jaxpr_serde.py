@@ -62,6 +62,16 @@ def _build_primitive_registry() -> Dict[str, Any]:
         modules.append(_core)              # pvary_p (vma adjustment)
     except ImportError:  # pragma: no cover - internal layout moved
         pass
+    try:
+        # Pallas kernels ship over RPC as first-class jaxprs: the call
+        # primitive itself, the in-kernel Ref state primitives (get/swap/
+        # addupdate), and pallas helper prims (program_id etc.).
+        import jax._src.pallas.pallas_call as m14
+        import jax._src.pallas.primitives as m15
+        import jax._src.state.primitives as m16
+        modules.extend([m14, m15, m16])
+    except ImportError:  # pragma: no cover - internal layout moved
+        pass
     for mod in modules:
         for name in dir(mod):
             obj = getattr(mod, name, None)
@@ -95,7 +105,76 @@ _ENUMS = {
     "Precision": _lax.Precision,
     "RandomAlgorithm": getattr(_lax, "RandomAlgorithm", None),
 }
+try:
+    import jax._src.pallas.core as _pl_core
+    _ENUMS["PallasMemorySpace"] = _pl_core.MemorySpace
+except ImportError:  # pragma: no cover - internal layout moved
+    _pl_core = None
 _ENUMS = {k: v for k, v in _ENUMS.items() if v is not None}
+
+
+# --------------------------------------------------------------------------
+# PyTreeDef encoding (pallas get/swap `tree` params, GridMapping trees).
+#
+# A PyTreeDef is encoded structurally via node_data()/children() and rebuilt
+# on the receiver by constructing a template pytree (with opaque leaf
+# markers) and taking its tree_structure. Custom nodes are limited to the
+# allowlist below — the indexing types pallas state primitives put in their
+# treedefs — so an unknown custom node fails loudly at serialization time
+# rather than decoding wrongly.
+# --------------------------------------------------------------------------
+
+def _treedef_node_types() -> Dict[str, Any]:
+    types: Dict[str, Any] = {"tuple": tuple, "list": list, "dict": dict,
+                             "NoneType": type(None)}
+    try:
+        from jax._src.state.indexing import NDIndexer, Slice
+        types["NDIndexer"] = NDIndexer
+        types["Slice"] = Slice
+    except ImportError:  # pragma: no cover - internal layout moved
+        pass
+    return types
+
+
+_TREEDEF_NODES = _treedef_node_types()
+
+
+class _TreeLeaf:
+    """Opaque leaf marker used when rebuilding treedef templates."""
+
+
+def _enc_treedef(td) -> dict:
+    nd = td.node_data()
+    if nd is None:
+        return {"k": "leaf"}
+    cls, aux = nd
+    name = cls.__name__
+    if name not in _TREEDEF_NODES:
+        raise TypeError(f"treedef custom node {name!r} not serializable; "
+                        "extend _treedef_node_types")
+    return {"k": "node", "cls": name, "aux": encode_value(aux),
+            "children": [_enc_treedef(c) for c in td.children()]}
+
+
+def _dec_treedef_template(d: dict) -> Any:
+    if d["k"] == "leaf":
+        return _TreeLeaf()
+    cls = _TREEDEF_NODES[d["cls"]]
+    children = [_dec_treedef_template(c) for c in d["children"]]
+    aux = decode_value(d["aux"])
+    if cls is tuple:
+        return tuple(children)
+    if cls is list:
+        return list(children)
+    if cls is dict:
+        return dict(zip(aux, children))
+    if cls is type(None):
+        return None
+    return cls.tree_unflatten(aux, children)
+
+
+def _dec_treedef(d: dict):
+    return jax.tree_util.tree_structure(_dec_treedef_template(d))
 
 
 # --------------------------------------------------------------------------
@@ -188,6 +267,31 @@ def encode_value(v: Any) -> Any:
                       for e in tuple(v)]}
     if isinstance(v, frozenset):
         return {"t": "frozenset", "v": sorted(encode_value(x) for x in v)}
+    if type(v).__name__ == "PyTreeDef":
+        return {"t": "treedef", "v": _enc_treedef(v)}
+    if isinstance(v, _core.AbstractValue):
+        # Avals appear as params of pallas_call (out_avals, GridMapping's
+        # index_map/scratch avals, BlockMapping array/block avals).
+        return {"t": "aval", "v": _aval_dict(v)}
+    if _pl_core is not None:
+        import dataclasses as _dc
+        for cls_name in ("Blocked", "Element", "Squeezed"):
+            cls = getattr(_pl_core, cls_name, None)
+            if cls is not None and isinstance(v, cls):
+                return {"t": "pl_dim", "cls": cls_name,
+                        "v": [encode_value(getattr(v, f.name))
+                              for f in _dc.fields(cls)]}
+        for cls_name in ("BlockMapping", "GridMapping"):
+            cls = getattr(_pl_core, cls_name, None)
+            if cls is not None and isinstance(v, cls):
+                return {"t": "pl_" + cls_name.lower(),
+                        "v": {f.name: encode_value(getattr(v, f.name))
+                              for f in _dc.fields(cls)}}
+        from jax._src.frozen_dict import FrozenDict as _FrozenDict
+        if isinstance(v, _FrozenDict):
+            return {"t": "pl_frozendict",
+                    "v": [[encode_value(k), encode_value(x)]
+                          for k, x in dict(v).items()]}
     raise TypeError(
         f"cannot serialize param value of type {type(v).__name__}: {v!r}")
 
@@ -256,6 +360,21 @@ def decode_value(v: Any) -> Any:
             for e in v["v"]])
     if t == "frozenset":
         return frozenset(decode_value(x) for x in v["v"])
+    if t == "treedef":
+        return _dec_treedef(v["v"])
+    if t == "aval":
+        return _make_aval(v["v"])
+    if t == "pl_dim":
+        cls = getattr(_pl_core, v["cls"])
+        return cls(*[decode_value(x) for x in v["v"]])
+    if t in ("pl_blockmapping", "pl_gridmapping"):
+        cls = (_pl_core.BlockMapping if t == "pl_blockmapping"
+               else _pl_core.GridMapping)
+        return cls(**{k: decode_value(x) for k, x in v["v"].items()})
+    if t == "pl_frozendict":
+        from jax._src.frozen_dict import FrozenDict as _FrozenDict
+        return _FrozenDict({decode_value(k): decode_value(x)
+                            for k, x in v["v"]})
     raise TypeError(f"unknown tag {t}")
 
 
@@ -264,6 +383,13 @@ def decode_value(v: Any) -> Any:
 # --------------------------------------------------------------------------
 
 def _aval_dict(aval) -> dict:
+    if type(aval).__name__ == "AbstractRef":
+        # Pallas/state Ref avals (kernel operands, scratch): inner aval +
+        # memory space. The memory space is a pallas MemorySpace enum (or
+        # None = default), encoded by name.
+        ms = aval.memory_space
+        return {"ref": _aval_dict(aval.inner_aval),
+                "memory_space": None if ms is None else encode_value(ms)}
     d = {
         "shape": list(aval.shape),
         "dtype": (np.dtype(aval.dtype).name
@@ -285,6 +411,11 @@ def _aval_dict(aval) -> dict:
 
 
 def _make_aval(d: dict):
+    if "ref" in d:
+        from jax._src.state.types import AbstractRef
+        ms = d.get("memory_space")
+        return AbstractRef(_make_aval(d["ref"]),
+                           None if ms is None else decode_value(ms))
     if d["dtype"] == "float0":
         return _core.ShapedArray(tuple(d["shape"]), jax.dtypes.float0)
     kw = {}
@@ -373,6 +504,11 @@ def _decode_jaxpr_struct(d: dict):
             else:
                 outv.append(dec_atom(a))
         params = {k: decode_value(v) for k, v in e["params"].items()}
+        if prim.name == "pallas_call":
+            # The `interpret` flag is a property of the EXECUTING backend,
+            # not the program: a kernel traced on TPU must run in interpret
+            # mode on a CPU server (tests, virtual meshes) and vice versa.
+            params["interpret"] = jax.default_backend() == "cpu"
         ctx = None
         if "ctx_mesh" in e:
             import jax as _jax
@@ -381,8 +517,21 @@ def _decode_jaxpr_struct(d: dict):
             # The constructor snapshots the AMBIENT abstract mesh; restore
             # the recorded one (the manual mesh this eqn was traced under).
             ctx.cur_abstract_mesh = decode_value(e["ctx_mesh"])
+        # Recompute the eqn's effects (Ref read/write effects inside pallas
+        # kernels, and their propagation through while/scan/cond/jit):
+        # effects aren't serialized — abstract_eval re-derives them from the
+        # decoded avals+params. Prims whose abstract_eval needs ambient
+        # context we can't reproduce here keep no_effects (the pre-pallas
+        # behaviour, correct for all effect-free lax prims).
+        effects = _core.no_effects
+        try:
+            out = prim.abstract_eval(*[x.aval for x in inv], **params)
+            if isinstance(out, tuple) and len(out) == 2:
+                effects = out[1]
+        except Exception:
+            pass
         eqns.append(_core.new_jaxpr_eqn(
-            inv, outv, prim, params, effects=_core.no_effects, ctx=ctx))
+            inv, outv, prim, params, effects=effects, ctx=ctx))
     outvars = [dec_atom(a) for a in d["outvars"]]
     import warnings
     with warnings.catch_warnings():

@@ -274,3 +274,77 @@ def test_shard_map_round_trip(devices):
             for x, y in zip(a, b):
                 np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                            rtol=1e-5)
+
+
+def test_pallas_flash_attention_round_trip():
+    """pallas_call crosses the wire: kernel jaxpr (Ref avals, state
+    primitives with NDIndexer treedefs), GridMapping/BlockMapping params,
+    and recomputed Ref effects. The interpret flag is rebound to the
+    receiving backend, so a TPU-traced kernel evaluates on a CPU server
+    (reference parity: client.cc ships *all* programs as HLO — pallas
+    kernels were the last program family that couldn't travel)."""
+    from tepdist_tpu.ops.pallas.flash_attention import flash_attention
+    from tepdist_tpu.rpc.jaxpr_serde import (
+        deserialize_closed_jaxpr,
+        serialize_closed_jaxpr,
+    )
+
+    B, H, T, D = 1, 2, 256, 64
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (B, H, T, D))
+    k = jax.random.normal(k2, (B, H, T, D))
+    v = jax.random.normal(k3, (B, H, T, D))
+
+    def f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v).astype(jnp.float32))
+
+    for make, tol in ((lambda: jax.make_jaxpr(f)(q, k, v), 1e-5),
+                      (lambda: jax.make_jaxpr(
+                          jax.grad(f, argnums=(0, 1, 2)))(q, k, v), 1e-4)):
+        closed = make()
+        rt = deserialize_closed_jaxpr(serialize_closed_jaxpr(closed))
+        a = jax.core.eval_jaxpr(closed.jaxpr, closed.consts, q, k, v)
+        b = jax.core.eval_jaxpr(rt.jaxpr, rt.consts, q, k, v)
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=tol, atol=1e-5)
+        # And under jit: the decoded eqns must survive XLA lowering.
+        jf = jax.jit(lambda *args: jax.core.eval_jaxpr(
+            rt.jaxpr, rt.consts, *args))
+        for x, y in zip(a, jf(q, k, v)):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=tol, atol=1e-5)
+
+
+def test_pallas_flash_gpt2_train_step_round_trip():
+    """A full flash-attention GPT-2 train step (value_and_grad + adamw)
+    serializes and evaluates identically — the config NOTES_NEXT round 2
+    flagged as unshippable."""
+    from tepdist_tpu.models import gpt2
+    from tepdist_tpu.rpc.jaxpr_serde import (
+        deserialize_closed_jaxpr,
+        serialize_closed_jaxpr,
+    )
+
+    # T must be a multiple of the flash block size; block sizes clamp to T.
+    cfg = gpt2.GPT2Config(vocab_size=128, n_ctx=128, n_embd=32, n_layer=2,
+                          n_head=2, dtype=jnp.float32, attn="flash")
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg, 2, 128)
+    tx = optax.adamw(1e-3)
+    opt_state = tx.init(params)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt2.loss_fn(p, tokens, cfg))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), opt_state
+
+    flat, _ = jax.tree_util.tree_flatten(((params, opt_state, tokens), {}))
+    closed = jax.make_jaxpr(step)(params, opt_state, tokens)
+    rt = deserialize_closed_jaxpr(serialize_closed_jaxpr(closed))
+    a = jax.core.eval_jaxpr(closed.jaxpr, closed.consts, *flat)
+    b = jax.core.eval_jaxpr(rt.jaxpr, rt.consts, *flat)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-5, atol=1e-6)

@@ -390,3 +390,44 @@ def test_long_context_ring_attention_over_rpc(server):
         l, p, o = local(p, o, tokens)
         ref.append(float(l))
     np.testing.assert_allclose(remote, ref, rtol=1e-4)
+
+
+def test_flash_attention_gpt2_over_rpc(server):
+    """pallas_call serde end-to-end: a flash-attention GPT-2 trains THROUGH
+    the client/server RPC surface (NOTES_NEXT r2 gap #3). The serialized
+    module carries the pallas_call eqns (kernel jaxpr + GridMapping); the
+    server re-binds interpret mode for its own backend and remote losses
+    match local training exactly."""
+    import dataclasses
+
+    import numpy as np
+
+    from tepdist_tpu.models import gpt2
+
+    port, _ = server
+    # flash blocks need T % block == 0; blocks clamp to T=64.
+    cfg = dataclasses.replace(gpt2.CONFIGS["test"], attn="flash")
+
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg, 4, 32)
+    tx = optax.adamw(1e-3)
+    opt_state = tx.init(params)
+
+    def step(params, opt_state, tokens):
+        l, g = jax.value_and_grad(
+            lambda p: gpt2.loss_fn(p, tokens, cfg))(params)
+        u, opt_state = tx.update(g, opt_state, params)
+        return l, optax.apply_updates(params, u), opt_state
+
+    sess = TepdistSession(f"127.0.0.1:{port}", mesh_axes=[("data", 1)])
+    sess.compile_train_step(step, params, opt_state, tokens)
+    remote = [sess.run(tokens) for _ in range(3)]
+    sess.close()
+
+    local = jax.jit(step)
+    p, o = params, opt_state
+    ref = []
+    for _ in range(3):
+        l, p, o = local(p, o, tokens)
+        ref.append(float(l))
+    np.testing.assert_allclose(remote, ref, rtol=1e-4)
