@@ -20,6 +20,10 @@ RPC surface (method -> reference RPC):
   InitMeshTopology      -> InitRemoteNcclComm (communicator setup -> mesh)
   DoRemoteSave          -> DoRemoteSave
   DoRemoteRestore       -> DoRemoteRestore
+  AbortStep             -> (no reference analogue: cancels an in-flight
+                           ExecuteRemotePlan's recv waits so mid-step
+                           worker death is detected at heartbeat latency,
+                           not RPC-timeout latency)
   Ping                  -> GetDeviceHandles (liveness/metadata)
 """
 
@@ -46,6 +50,7 @@ METHODS = [
     "InitMeshTopology",
     "DoRemoteSave",
     "DoRemoteRestore",
+    "AbortStep",
     "Ping",
 ]
 

@@ -25,12 +25,19 @@ import jax
 import jax.numpy as jnp
 
 
+class StepAbortedError(RuntimeError):
+    """Raised out of a blocking recv when the master aborts the step
+    (a peer worker died mid-step and this worker's inputs will never
+    arrive)."""
+
+
 class RawStore:
     """Keyed host store with blocking get (the kRecv wait)."""
 
     def __init__(self):
         self._data: Dict[str, Any] = {}
         self._cv = threading.Condition()
+        self._aborted = False
 
     def put(self, key: str, value: Any) -> None:
         with self._cv:
@@ -43,11 +50,25 @@ class RawStore:
         deadline = time.time() + timeout
         with self._cv:
             while key not in self._data:
+                if self._aborted:
+                    raise StepAbortedError(
+                        f"step aborted while waiting for {key!r}")
                 remaining = deadline - time.time()
                 if remaining <= 0:
                     raise TimeoutError(f"raw data {key!r} never arrived")
                 self._cv.wait(remaining)
             return self._data[key]
+
+    def abort(self) -> None:
+        """Wake every blocked get with StepAbortedError (master-initiated
+        cancellation: a peer died, this step cannot complete)."""
+        with self._cv:
+            self._aborted = True
+            self._cv.notify_all()
+
+    def reset_abort(self) -> None:
+        with self._cv:
+            self._aborted = False
 
     def clear_step(self, step: int) -> None:
         suffix = f":{step}"
@@ -117,6 +138,8 @@ class WorkerPlan:
         self.task_index = plan_meta["task_index"]
         self.num_micro = plan_meta["num_micro_batches"]
         self.raw = servicer.raw_store
+        # Stamped onto peer pushes; receivers drop mismatched generations.
+        self.plan_gen = getattr(servicer, "plan_gen", 0)
         self._peers: Dict[int, Any] = {}
         # stage id -> StageModuleRuntime (from servicer.stage_modules)
         self.stages = servicer.stage_modules
@@ -236,14 +259,23 @@ class WorkerPlan:
                                 metas.append(m)
                                 blobs.append(b)
                             payload = protocol.pack(
-                                {"raw_key": key, "literals": metas}, blobs)
+                                {"raw_key": key, "plan_gen": self.plan_gen,
+                                 "literals": metas}, blobs)
                         else:
                             meta_l, blob = protocol.encode_literal(
                                 np.asarray(jax.device_get(val)))
                             payload = protocol.pack(
-                                {"raw_key": key, "literal": meta_l}, [blob])
+                                {"raw_key": key, "plan_gen": self.plan_gen,
+                                 "literal": meta_l}, [blob])
+                        # Abort-aware peer send: a bounded timeout (matching
+                        # the recv wait) instead of the 300s RPC default,
+                        # and an abort check so a cancelled step doesn't pin
+                        # this worker inside a send to a dead/stuck peer.
+                        if self.raw._aborted:
+                            raise StepAbortedError(
+                                f"step aborted before send {key!r}")
                         self._peer(peer_worker).stub.call(
-                            "TransferHostRawData", payload)
+                            "TransferHostRawData", payload, timeout=60.0)
             elif tt == "recv":
                 parent = task["input_specs"].get("0")
                 if parent is not None and parent[0] in outputs:

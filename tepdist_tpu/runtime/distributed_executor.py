@@ -11,6 +11,7 @@ p2p path becomes RPC raw-data pushes over DCN).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -29,6 +30,13 @@ from tepdist_tpu.runtime.task_scheduler import TaskScheduler
 
 class DistributedPipelineSession:
     """Drive a pipeline across tepdist worker servers."""
+
+    # Monotonic plan-generation counter (per master process): every
+    # session/re-dispatch stamps its DispatchPlan and raw-data pushes with
+    # a fresh generation, and workers drop pushes from older generations
+    # (an evicted-but-alive worker resuming a wedged step cannot inject
+    # stale activations into the rebuilt plan — r2 review finding).
+    _gen_counter = 0
 
     def __init__(self, prog: PipelineProgram, cluster: ClusterSpec,
                  learning_rate: float = 0.01, optimizer=None,
@@ -52,6 +60,8 @@ class DistributedPipelineSession:
         self.prog = prog
         self.cluster = cluster
         self.lr = learning_rate
+        DistributedPipelineSession._gen_counter += 1
+        self._plan_gen = DistributedPipelineSession._gen_counter
         self._optimizer = optimizer
         self._elastic = elastic
         self._autosave_every = autosave_every
@@ -214,6 +224,7 @@ class DistributedPipelineSession:
             self.clients[ti].stub.call("DispatchPlan", protocol.pack({
                 "tasks": [serialize_task(n) for n in tasks],
                 "plan_meta": plan_meta,
+                "plan_gen": self._plan_gen,
             }))
         self._step = 0
         # Heartbeat monitor (surplus over the reference, which had no
@@ -298,13 +309,18 @@ class DistributedPipelineSession:
                         self.clients[ti].stub.call(
                             "TransferHostRawData", protocol.pack(
                                 {"raw_key": f"batch:{step}:{m}:{gi}",
+                                 "plan_gen": self._plan_gen,
                                  "literal": meta}, [blob]))
                 except Exception as e:  # noqa: BLE001
                     push_errors[ti] = e
                     break
         if push_errors:
-            self.health.check_once()
-            self.health.dead |= set(push_errors)
+            # Same healthy-vs-dead split as the execute path below: a push
+            # can fail transiently (e.g. a slow restart) without the
+            # worker being gone.
+            status = self.health.check_once()
+            self.health.dead |= {ti for ti in push_errors
+                                 if not status.get(ti, False)}
             if self._elastic:
                 attempts = getattr(self, "_redispatch_attempts", 0)
                 if attempts >= self.cluster.num_workers:
@@ -331,16 +347,24 @@ class DistributedPipelineSession:
             except Exception as e:  # noqa: BLE001
                 errors[ti] = e
 
-        threads = [threading.Thread(target=run, args=(ti, c))
+        threads = [threading.Thread(target=run, args=(ti, c), daemon=True)
                    for ti, c in self.clients.items()]
         for t in threads:
             t.start()
-        for t in threads:
-            t.join()
+        self._join_with_heartbeat(threads, errors)
+        # Snapshot: abandoned daemon threads (still blocked past the grace
+        # join) may write into `errors` while we iterate it below.
+        errors = dict(errors)
         if errors:
-            # Distinguish dead workers from transient RPC errors.
-            self.health.check_once()
-            self.health.dead |= set(errors)
+            # Distinguish dead workers from survivors whose step merely
+            # failed/aborted (e.g. StepAbortedError after a peer died):
+            # only workers whose ping ALSO fails right now are declared
+            # dead — a healthy worker that errored must stay in the
+            # cluster or elastic re-dispatch would evict the survivors it
+            # is about to rebuild onto.
+            status = self.health.check_once()
+            self.health.dead |= {ti for ti in errors
+                                 if not status.get(ti, False)}
             if self._elastic:
                 attempts = getattr(self, "_redispatch_attempts", 0)
                 if attempts >= self.cluster.num_workers:
@@ -360,6 +384,49 @@ class DistributedPipelineSession:
                 and self._step % self._autosave_every == 0):
             self.save()
         return float(sum(losses) / max(len(losses), 1))
+
+    # ------------------------------------------------------------------
+    abort_grace_s: float = 10.0   # how long to wait for aborted RPCs
+
+    def _join_with_heartbeat(self, threads, errors: Dict[int, Exception],
+                             grace_s: Optional[float] = None) -> None:
+        """Join the per-worker ExecuteRemotePlan threads, heartbeating the
+        fleet while they run. Without this, a worker dying MID-step is only
+        noticed when some RPC times out (recv timeout 60s / RPC timeout
+        300s). With it, the heartbeat declares the worker dead within
+        ~interval*max_misses seconds, AbortStep wakes the surviving
+        workers' blocked recvs, and the elastic path reacts immediately.
+        Reference parity: none — the reference has no mid-step failure
+        detection at all (SURVEY §5.3)."""
+        if grace_s is None:
+            grace_s = self.abort_grace_s
+        poll = max(self.health.interval, 0.5)
+        while True:
+            alive = [t for t in threads if t.is_alive()]
+            if not alive:
+                return
+            alive[0].join(timeout=poll)
+            if any(t.is_alive() for t in threads):
+                before = set(self.health.dead)
+                self.health.check_once()
+                newly_dead = self.health.dead - before
+                if newly_dead:
+                    for ti in self.health.dead:
+                        errors.setdefault(ti, RuntimeError(
+                            "worker died mid-step (heartbeat)"))
+                    # Wake survivors' recv waits so their RPCs return now.
+                    for ti, client in self.clients.items():
+                        if ti in self.health.dead:
+                            continue
+                        try:
+                            client.stub.call("AbortStep", protocol.pack({}),
+                                             timeout=self.health.timeout)
+                        except Exception:  # noqa: BLE001 - dying too
+                            pass
+                    deadline = time.time() + grace_s
+                    for t in threads:
+                        t.join(timeout=max(0.0, deadline - time.time()))
+                    return
 
     # ------------------------------------------------------------------
     def _auto_redispatch(self) -> None:

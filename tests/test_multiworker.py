@@ -437,3 +437,121 @@ def test_auto_redispatch_onto_shrunken_cluster(tmp_path):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
         got, jax.device_get(p))
+
+
+@pytest.mark.parametrize("victim_ti", [1, 0])
+def test_mid_step_worker_death_detected_by_heartbeat(tmp_path, victim_ti):
+    """NOTES_NEXT r2 gap #4: a worker dying (here: wedging, via SIGSTOP)
+    DURING ExecuteRemotePlan must be detected at heartbeat latency, not by
+    waiting out the 60s recv / 300s RPC timeouts. The master's
+    heartbeat-polling join declares the worker dead, AbortStep wakes the
+    survivor's blocked recvs, and the elastic path re-dispatches onto the
+    survivor — the step retries and the trajectory still equals an
+    uninterrupted run.
+
+    victim_ti=1 wedges the downstream (loss) worker: the survivor blocks
+    inside a peer SEND and returns via the bounded send timeout / grace
+    join. victim_ti=0 wedges the upstream worker: the survivor blocks in
+    a recv wait, AbortStep wakes it with StepAbortedError, and — the r2
+    review's finding — the healthy-but-aborted survivor must NOT be
+    declared dead by the error path, or re-dispatch would have no
+    survivors left."""
+    import time as _time
+
+    def loss_fn(params, x, y):
+        h = x
+        for i in range(4):
+            h = jnp.tanh(h @ params[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    keys = jax.random.split(k, 6)
+    params = {f"w{i}": jax.random.normal(keys[i], (32, 32)) * 0.3
+              for i in range(4)}
+    x = jax.random.normal(keys[4], (16, 32))
+    y = jax.random.normal(keys[5], (16, 32))
+    prog = plan_pipeline(loss_fn, 2, 2, params, x, y)
+    tx = optax.adam(1e-2)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["TEPDIST_CKPT_DIR"] = str(tmp_path)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def spawn(task_index, port):
+        return subprocess.Popen(
+            [sys.executable, "-m", "tepdist_tpu.rpc.server",
+             "--port", str(port), "--platform", "cpu",
+             "--task_index", str(task_index)],
+            env=env, cwd=root,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    p0_port, p1_port = _free_port(), _free_port()
+    w0, w1 = spawn(0, p0_port), spawn(1, p1_port)
+    from tepdist_tpu.rpc.client import TepdistClient
+    for p in (p0_port, p1_port):
+        c = TepdistClient(f"127.0.0.1:{p}")
+        c.wait_ready(60)
+        c.close()
+    try:
+        cluster = ClusterSpec([
+            WorkerSpec("127.0.0.1", p0_port, [0], task_index=0),
+            WorkerSpec("127.0.0.1", p1_port, [0], task_index=1),
+        ])
+        sess = DistributedPipelineSession(prog, cluster, optimizer=tx,
+                                          elastic=True, autosave_every=1)
+        # Fast heartbeats so detection latency is test-sized.
+        sess.health.interval = 0.5
+        sess.health.timeout = 0.5
+        sess.abort_grace_s = 5.0
+        sess.load_variables(params)
+        losses = [sess.step(x, y)]
+
+        # Wedge the victim the moment its NEXT ExecuteRemotePlan is
+        # issued: the batch pushes succeed (it is alive), then it stops
+        # mid-step.
+        victim_proc = {0: w0, 1: w1}[victim_ti]
+        victim = sess.clients[victim_ti].stub
+        orig_call = victim.call
+
+        def stopping_call(method, payload, timeout=300.0):
+            if method == "ExecuteRemotePlan":
+                victim_proc.send_signal(signal.SIGSTOP)
+            return orig_call(method, payload, timeout=timeout)
+
+        victim.call = stopping_call
+        t0 = _time.monotonic()
+        losses.append(sess.step(x, y))      # detect + re-dispatch + retry
+        detect_s = _time.monotonic() - t0
+        losses += [sess.step(x, y) for _ in range(2)]
+        assert sess.cluster.num_workers == 1   # survivor adopted stage 1
+        # Detection must be heartbeat-speed, far under the 60s recv timeout.
+        assert detect_s < 45.0, f"mid-step death took {detect_s:.1f}s"
+        got = sess.fetch_variables()
+        sess.close()
+    finally:
+        for w in (w0, w1):
+            try:
+                w.send_signal(signal.SIGCONT)
+            except Exception:
+                pass
+            w.send_signal(signal.SIGKILL)
+            w.wait()
+
+    def apply_fn(pp, ss, g):
+        u, ss = tx.update(g, ss, pp)
+        return optax.apply_updates(pp, u), ss
+
+    ref_step = jax.jit(prog.reference_step(apply_fn))
+    p, s = params, tx.init(params)
+    ref = []
+    for _ in range(4):
+        l, p, s = ref_step(p, s, x, y)
+        ref.append(float(l))
+    np.testing.assert_allclose(losses, ref, rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
+        got, jax.device_get(p))
