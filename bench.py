@@ -92,10 +92,18 @@ def bench_gpt2_15b() -> dict:
     from tepdist_tpu.train import plan_training
 
     cfg = dataclasses.replace(gpt2.CONFIGS["1.5B"], attn="flash", remat=True,
-                              loss_chunk=512)
+                              remat_policy=os.environ.get(
+                                  "BENCH_15B_REMAT", "full"),
+                              loss_chunk=int(os.environ.get(
+                                  "BENCH_15B_LOSS_CHUNK", "512")),
+                              flash_block_q=int(os.environ.get(
+                                  "BENCH_15B_BLOCK_Q", "512")),
+                              flash_block_k=int(os.environ.get(
+                                  "BENCH_15B_BLOCK_K", "512")))
     n_params = gpt2.num_params(cfg)
-    batch, seq, micro, steps = 8, 1024, int(os.environ.get(
-        "BENCH_15B_MICRO", "4")), 3
+    batch = int(os.environ.get("BENCH_15B_BATCH", "48"))
+    seq, micro, steps = 1024, int(os.environ.get(
+        "BENCH_15B_MICRO", "16")), 3
 
     params = gpt2.stacked_init_params(cfg, jax.random.PRNGKey(0))
     tokens = gpt2.fake_batch(cfg, batch, seq)

@@ -308,13 +308,16 @@ def test_gpt2_chunked_cross_entropy_matches_dense(devices):
     (the [B*T, V] fp32 logits tensor never materialises). Loss and grads
     must match the dense path to float tolerance (summation order
     changes), in both the per-layer and stacked forms; a non-dividing
-    chunk falls back to dense."""
+    chunk runs via a masked tail chunk (the LM loss shifts tokens, so
+    n_tokens = B*(T-1) and power-of-two chunks NEVER divide — r2 review
+    caught the old divisibility fallback silently disabling chunking)."""
     import dataclasses
 
     from tepdist_tpu.models import gpt2
 
     cfg = gpt2.CONFIGS["test"]
-    cfg_c = dataclasses.replace(cfg, loss_chunk=31)   # 4*31 tokens/chunk=4
+    # tokens [4, 31] -> loss over 4*30 = 120 shifted targets; 30 divides.
+    cfg_c = dataclasses.replace(cfg, loss_chunk=30)
     params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
     tokens = gpt2.fake_batch(cfg, 4, 31)
 
@@ -333,7 +336,13 @@ def test_gpt2_chunked_cross_entropy_matches_dense(devices):
     l_sc = gpt2.loss_fn_stacked(sp, tokens, cfg_c)
     np.testing.assert_allclose(float(l_sc), float(l_s), rtol=1e-5)
 
-    # Non-dividing chunk: silently dense, same value.
-    cfg_nd = dataclasses.replace(cfg, loss_chunk=33)
-    l_nd = gpt2.loss_fn(params, tokens, cfg_nd)
-    np.testing.assert_allclose(float(l_nd), float(l_dense), rtol=1e-6)
+    # Non-dividing chunk: masked tail chunk, same value AND grads (120 %
+    # 32 = 24 — this exercises the padded path end to end).
+    cfg_nd = dataclasses.replace(cfg, loss_chunk=32)
+    l_nd, g_nd = jax.value_and_grad(
+        lambda p: gpt2.loss_fn(p, tokens, cfg_nd))(params)
+    np.testing.assert_allclose(float(l_nd), float(l_dense), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        g_nd, g_dense)
