@@ -36,11 +36,11 @@ class GPT2Config:
     # Rematerialise each transformer block in backward (jax.checkpoint):
     # trades recompute FLOPs for activation HBM — how the big configs fit.
     remat: bool = False
-    # Remat policy when remat=True: "full" recomputes the whole block in
-    # backward (minimum memory); "dots" saves matmul outputs
-    # (dots_with_no_batch_dims_saveable) so the backward skips recomputing
-    # the MXU-heavy ops — ~1/3 fewer forward FLOPs in the backward wave at
-    # the cost of the saved activations' HBM.
+    # Remat policy when remat=True (vocabulary matches train.py's
+    # REMAT_POLICY knob): "full" recomputes the whole block in backward
+    # (minimum memory); "dots" saves matmul outputs (checkpoint_dots);
+    # "dots_no_batch" saves only no-batch-dim matmuls — the backward skips
+    # recomputing MXU-heavy ops at the cost of the saved activations' HBM.
     remat_policy: str = "full"
     # Flash attention tile sizes (0 = kernel default). Bigger q tiles mean
     # fewer grid steps/LSE traffic; sweepable per chip generation.
@@ -50,7 +50,8 @@ class GPT2Config:
     # tokens at a time under jax.checkpoint, so the [B*T, vocab] fp32
     # logits tensor never materialises (peak loss memory drops from
     # B*T*V*4 to chunk*V*4 bytes — the big configs' other memory wall).
-    # 0 = dense. Falls back to dense when B*T isn't divisible.
+    # 0 = dense. Non-dividing token counts use a zero-padded masked tail
+    # chunk (the LM loss shifts tokens, so counts are B*(T-1)).
     loss_chunk: int = 0
 
     @property
@@ -159,8 +160,15 @@ def mlp(block, x):
 
 def _remat_kwargs(cfg: GPT2Config) -> dict:
     if cfg.remat_policy == "dots":
+        return {"policy": jax.checkpoint_policies.checkpoint_dots}
+    if cfg.remat_policy == "dots_no_batch":
         return {"policy":
                 jax.checkpoint_policies.dots_with_no_batch_dims_saveable}
+    if cfg.remat_policy != "full":
+        raise ValueError(
+            f"unknown remat_policy {cfg.remat_policy!r}; expected "
+            "'full', 'dots', or 'dots_no_batch' (same vocabulary as "
+            "train.py's REMAT_POLICY)")
     return {}
 
 

@@ -268,47 +268,53 @@ class TaskScheduler:
             bwd_bonus = 0 if is_bwd(n) else 1
             return (n.micro if n.micro >= 0 else 0, bwd_bonus, n.id)
 
-        # pool: time-ready tasks (all parents finished) not yet started.
-        pool: List[int] = [n.id for n in dag.nodes if indeg[n.id] == 0]
+        # ready: dep-satisfied, unstarted tasks as a PRIORITY HEAP. A popped
+        # task that cannot start yet is PARKED on the resource blocking it
+        # (one busy device, or its stage's full 1F1B window) and re-enters
+        # the heap when exactly that resource frees — each task is pushed
+        # O(|device_group| + window events) times instead of the old
+        # rescan-the-whole-pool-per-start O(N*pool). Start order is
+        # unchanged: at any instant the heap pops the same minimum-priority
+        # startable task the linear scan chose (the native C++ core's
+        # bit-identical contract is asserted by tests/test_native_scheduler).
+        ready: List[Tuple[Tuple, int]] = [
+            (priority(n), n.id) for n in dag.nodes if indeg[n.id] == 0]
+        heapq.heapify(ready)
+        dev_parked: Dict[int, List[Tuple[Tuple, int]]] = {}
+        win_parked: Dict[int, List[Tuple[Tuple, int]]] = {}
         events: List[Tuple[float, int]] = []   # (finish_time, task id)
         sim_busy: Dict[int, float] = {}
         t_now = 0.0
 
-        def try_start() -> bool:
-            best = None
-            for tid in pool:
+        def drain_ready() -> None:
+            while ready:
+                pr, tid = heapq.heappop(ready)
                 n = dag.node(tid)
-                if any(dev_free[d] > t_now for d in n.device_group):
+                busy = next((d for d in n.device_group
+                             if dev_free[d] > t_now), None)
+                if busy is not None:
+                    dev_parked.setdefault(busy, []).append((pr, tid))
                     continue
                 if (is_fwd(n) and window > 0 and n.micro not in
                         inflight.get(n.stage, ()) and
                         len(inflight.get(n.stage, ())) >= window):
+                    win_parked.setdefault(n.stage, []).append((pr, tid))
                     continue        # 1F1B gate: stage window full
-                pr = priority(n)
-                if best is None or pr < best[0]:
-                    best = (pr, tid)
-            if best is None:
-                return False
-            tid = best[1]
-            pool.remove(tid)
-            n = dag.node(tid)
-            dur = self.task_time(n)
-            start[tid] = t_now
-            fin = t_now + dur
-            order.append(tid)
-            per_device.setdefault(tuple(n.device_group), []).append(tid)
-            for d in n.device_group:
-                dev_free[d] = fin
-                sim_busy[d] = sim_busy.get(d, 0.0) + (
-                    dur if n.task_type == TaskType.COMPUTE else 0.0)
-            if is_fwd(n):
-                inflight.setdefault(n.stage, set()).add(n.micro)
-            heapq.heappush(events, (fin, tid))
-            return True
+                dur = self.task_time(n)
+                start[tid] = t_now
+                fin = t_now + dur
+                order.append(tid)
+                per_device.setdefault(tuple(n.device_group), []).append(tid)
+                for d in n.device_group:
+                    dev_free[d] = fin
+                    sim_busy[d] = sim_busy.get(d, 0.0) + (
+                        dur if n.task_type == TaskType.COMPUTE else 0.0)
+                if is_fwd(n):
+                    inflight.setdefault(n.stage, set()).add(n.micro)
+                heapq.heappush(events, (fin, tid))
 
         while len(order) < len(dag.nodes):
-            while try_start():
-                pass
+            drain_ready()
             if not events:
                 raise RuntimeError("schedule deadlock: DAG not fully drained")
             # Advance to the next completion instant; process every event at
@@ -322,10 +328,17 @@ class TaskScheduler:
                 task_finish[tid] = t_now
                 if is_bwd(n):
                     inflight.setdefault(n.stage, set()).discard(n.micro)
+                    for item in win_parked.pop(n.stage, []):
+                        heapq.heappush(ready, item)
                 for c in n.children:
                     indeg[c] -= 1
                     if indeg[c] == 0:
-                        pool.append(c)
+                        heapq.heappush(ready,
+                                       (priority(dag.node(c)), c))
+                for d in n.device_group:
+                    if dev_free[d] <= t_now:
+                        for item in dev_parked.pop(d, []):
+                            heapq.heappush(ready, item)
 
         makespan = max(task_finish.values(), default=0.0)
         peak = self._memory_account(order)

@@ -59,3 +59,28 @@ def test_large_dag_uses_native_by_default():
     sched = TaskScheduler(dag)
     r = sched.schedule()  # should route through native without error
     assert len(r.order) == len(dag.nodes)
+
+
+def test_wide_dag_python_matches_native():
+    """The heap-based Python fallback (r2: parked-task event loop replacing
+    the O(N*pool) rescan) must stay bit-identical to the C++ core on WIDE
+    DAGs too — thousands of simultaneously-ready chains is the shape where
+    the old fallback crawled and where start-ordering bugs would hide."""
+    from tepdist_tpu.runtime.task_graph import TaskDAG, TaskType
+    from tepdist_tpu.runtime.task_scheduler import TaskScheduler
+
+    dag = TaskDAG()
+    for c in range(300):
+        prev = None
+        for k in range(3):
+            n = dag.add(TaskType.COMPUTE, f"fwd_c{c}_{k}", stage=0,
+                        micro=c % 8, device_group=[c % 16], flops=1e9)
+            if prev is not None:
+                dag.add_edge(prev, n)
+            prev = n
+    s = TaskScheduler(dag)
+    r_native = s._simulate(0, use_native=True)
+    r_py = s._simulate(0, use_native=False)
+    assert r_native.order == r_py.order
+    assert abs(r_native.makespan - r_py.makespan) < 1e-12
+    assert r_native.peak_bytes == r_py.peak_bytes
