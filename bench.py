@@ -160,7 +160,11 @@ def bench_gpt2_117m(on_tpu: bool) -> dict:
     else:
         cfg = gpt2.CONFIGS["test"]
         batch, seq, steps = 8, 32, 3
-        model_name = "gpt2_test"
+        # Device-count-qualified: the CPU fallback runs wherever it lands
+        # (1 host device without the test-env flag, 8 with it) and
+        # per-chip numbers across different counts must not share a
+        # baseline entry.
+        model_name = f"gpt2_test_{len(devices)}dev"
 
     params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
     tokens = gpt2.fake_batch(cfg, batch, seq)
@@ -320,6 +324,62 @@ def bench_wrn() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# llama-1B tokens/s (surplus model family; flash attention + auto plan).
+# ---------------------------------------------------------------------------
+
+def bench_llama() -> dict:
+    import dataclasses as _dc
+
+    import optax
+
+    from tepdist_tpu.core.mesh import MeshTopology
+    from tepdist_tpu.models import llama
+    from tepdist_tpu.parallel.auto_parallel import auto_parallel
+
+    cfg = _dc.replace(llama.CONFIGS["1B"], attn="flash")
+    batch, seq, steps = 4, 512, 10
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
+                                cfg.vocab_size)
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(params)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, tokens, cfg))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), opt_state
+
+    n_state = len(jax.tree_util.tree_leaves((params, opt_state)))
+    plan = auto_parallel(train_step,
+                         MeshTopology([("data", len(jax.devices()))]),
+                         params, opt_state, tokens,
+                         state_alias={1 + k: k for k in range(n_state)})
+    step = plan.executable()
+    flat, _ = jax.tree_util.tree_flatten(
+        ((params, opt_state, tokens), {}))
+    flat = [jax.device_put(v, s)
+            for v, s in zip(flat, plan.input_shardings())]
+
+    def thread_state(flat, outs):
+        n = len(outs) - 1
+        return list(outs[1:]) + flat[n:]
+
+    outs = step(*flat)
+    _ = float(jax.device_get(outs[0]))
+    flat = thread_state(flat, outs)
+    dt = _timed_best(step, flat, thread_state, steps)
+    tps = batch * seq * steps / dt
+    metric = "llama1b_tokens_per_sec"
+    return {
+        "metric": metric,
+        "value": round(tps, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(_vs_baseline(metric, tps), 4),
+    }
+
+
+# ---------------------------------------------------------------------------
 # GPT-MoE tokens/s (reference examples/gpt_moe).
 # ---------------------------------------------------------------------------
 
@@ -380,7 +440,34 @@ def bench_moe() -> dict:
     }
 
 
+def _probe_backend() -> None:
+    """The remote-TPU tunnel can wedge such that backend init HANGS (not
+    errors) — observed twice across rounds. Probe device init in a
+    subprocess with a timeout; if it hangs or dies, re-exec this process
+    pinned to CPU so the driver still records a real (fallback) line
+    instead of timing out with empty output."""
+    import subprocess
+
+    if os.environ.get("_TEPDIST_BENCH_REEXEC"):
+        return
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        return   # already pinned to CPU: nothing to probe
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=180, check=True, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+    except Exception:
+        env = dict(os.environ)
+        env.update({"_TEPDIST_BENCH_REEXEC": "1", "JAX_PLATFORMS": "cpu",
+                    "PALLAS_AXON_POOL_IPS": ""})
+        sys.stderr.write("bench: TPU backend init hung/failed; "
+                         "re-running on CPU\n")
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
 def main() -> None:
+    _probe_backend()
     devices = jax.devices()
     on_tpu = devices[0].platform != "cpu"
 
@@ -401,17 +488,34 @@ def main() -> None:
             headline = bench_gpt2_15b()
         except Exception:
             headline_err = traceback.format_exc(limit=5)
+        if headline is not None:
+            # Emit the headline the moment it exists (flush!): if a later
+            # secondary line wedges past the driver's bench timeout, the
+            # recorded stdout still carries the real number.
+            print(json.dumps(headline), flush=True)
 
     # Secondary lines, cheapest first; each is budgeted so a slow/seized
-    # config cannot starve the rest (driver-side bench timeout).
+    # config cannot starve the rest (driver-side bench timeout), and
+    # bench_extra.json is rewritten after EVERY line for the same reason.
     extra = []
     budget_deadline = time.monotonic() + float(
         os.environ.get("BENCH_EXTRA_BUDGET_S", "240"))
+
+    def flush_extra():
+        try:
+            tmp = f"{EXTRA_FILE}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"extra": extra, "headline": headline,
+                           "headline_error": headline_err}, f, indent=1)
+            os.replace(tmp, EXTRA_FILE)   # atomic: a mid-write kill
+        except Exception:                 # cannot truncate prior lines
+            pass
     selected = {
         "117m": lambda: bench_gpt2_117m(True),
         "flash": bench_flash_attention_long,
         "wrn": bench_wrn,
         "moe": bench_moe,
+        "llama": bench_llama,
     }
     if only and only != "15b":
         selected = {k: v for k, v in selected.items() if k == only}
@@ -430,14 +534,8 @@ def main() -> None:
             extra.append({"metric": name, "error":
                           traceback.format_exc(limit=3).splitlines()[-1],
                           "bench_seconds": round(time.monotonic() - t0, 1)})
-
-    try:
-        json.dump({"extra": extra,
-                   "headline": headline,
-                   "headline_error": headline_err},
-                  open(EXTRA_FILE, "w"), indent=1)
-    except Exception:
-        pass
+        flush_extra()
+    flush_extra()
 
     if headline is None:
         # Headline skipped (BENCH_ONLY) or failed: print the selected /
@@ -451,7 +549,7 @@ def main() -> None:
             return
         print(json.dumps(line))
         return
-    print(json.dumps(headline))
+    # (headline already printed above, immediately after measurement)
 
 
 if __name__ == "__main__":

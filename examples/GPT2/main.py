@@ -37,6 +37,10 @@ def main():
                              "collective: single-jit shard_map+ppermute")
     parser.add_argument("--num_micro_batches", type=int, default=1)
     parser.add_argument("--mode", default="cost", choices=["cost", "rule"])
+    parser.add_argument("--data", default="",
+                        help="path to a packed token file "
+                             "(tepdist_tpu.data.pack_token_file); default "
+                             "is fake input (reference FAKE_INPUT mode)")
     args = parser.parse_args()
 
     from tepdist_tpu.core.mesh import MeshTopology
@@ -58,7 +62,15 @@ def main():
     print(f"GPT-2 {args.config}: ~{gpt2.num_params(cfg)/1e6:.0f}M params")
 
     params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
-    tokens = gpt2.fake_batch(cfg, args.batch, args.seq)
+    if args.data:
+        from tepdist_tpu.data import TokenDataset
+        dataset = TokenDataset(args.data)
+        batches = dataset.batches(args.batch, args.seq, seed=0)
+        tokens = next(batches)
+        print(f"dataset: {len(dataset):,} tokens from {args.data}")
+    else:
+        batches = None
+        tokens = gpt2.fake_batch(cfg, args.batch, args.seq)
     tx = optax.adamw(1e-4, b1=0.9, b2=0.95, weight_decay=0.01)
 
     if args.num_stages > 1 and args.pipeline == "collective":
@@ -92,6 +104,8 @@ def main():
               f"loss={float(l):.4f}")
         for i in range(args.steps):
             t0 = time.perf_counter()
+            if batches is not None:
+                tokens = next(batches)
             l, state, opt = cstep(state, opt, tokens)
             l = float(l)
             print(f"step {i}: loss={l:.4f} "
@@ -112,6 +126,8 @@ def main():
               f"flops={['%.2e' % f for f in prog.stage_flops()]}")
         for i in range(args.steps):
             t0 = time.perf_counter()
+            if batches is not None:
+                tokens = next(batches)
             loss = exe.step(tokens)
             dt = time.perf_counter() - t0
             print(f"step {i}: loss={loss:.4f} ({dt*1e3:.1f} ms)")
@@ -139,9 +155,17 @@ def main():
             for v, s in zip(flat, plan.input_shardings())]
     outs = step(*flat)
     _ = float(jax.device_get(outs[0]))  # compile + warm
+    n_state_out = len(outs) - 1
+    token_sharding = plan.input_shardings()[-1]
+    prefetch = None
+    if batches is not None:
+        from tepdist_tpu.data import DevicePrefetcher
+        prefetch = DevicePrefetcher(batches, shardings=token_sharding)
     for i in range(args.steps):
         t0 = time.perf_counter()
-        flat = list(outs[1:]) + flat[len(outs) - 1:]
+        flat = list(outs[1:]) + flat[n_state_out:]
+        if prefetch is not None:
+            flat[-1] = next(prefetch)
         outs = step(*flat)
         loss = float(jax.device_get(outs[0]))
         dt = time.perf_counter() - t0

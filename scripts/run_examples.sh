@@ -28,6 +28,11 @@ echo "== wide_resnet =="
 python examples/wide_resnet/train_imagenet.py --model_type -1 --batch 16 \
     --image_size 32 --steps 2
 
+echo "== llama (einsum + flash attention) =="
+python examples/llama/train.py --config test --batch 4 --seq 32 --steps 2
+python examples/llama/train.py --config test --batch 4 --seq 32 --steps 2 \
+    --attn flash
+
 echo "== gpt_moe =="
 python examples/gpt_moe/pretrain_gpt_moe.py --config test --batch 4 \
     --seq 32 --steps 2

@@ -1,0 +1,22 @@
+"""Input pipeline: host-side token datasets + device prefetch.
+
+Reference parity: the reference ships per-step input literals from the
+client on every step (``TransferToServerHost``/``TransferHostRawData``,
+reference: jit/kernels/xla_ops.cc:640-878) and otherwise benchmarks with
+``FAKE_INPUT`` (reference: service_env.h). It has no dataset library of its
+own — the TF examples lean on tf.data from the upstream model repos. This
+package is the TPU-native equivalent of that missing piece: a zero-copy
+memmapped token store (``tokens``) and a background-thread host→device
+prefetcher (``prefetch``) so step N+1's input transfer overlaps step N's
+compute.
+"""
+
+from tepdist_tpu.data.prefetch import (  # noqa: F401
+    DevicePrefetcher,
+    fake_input_iterator,
+)
+from tepdist_tpu.data.tokens import (  # noqa: F401
+    TokenDataset,
+    encode_bytes,
+    pack_token_file,
+)

@@ -118,13 +118,20 @@ def inline_calls(jaxpr, max_depth: int = 16):
             # Control-flow sub-jaxprs keep their structure but their BODIES
             # are inlined too (scan bodies otherwise retain jit/custom_jvp
             # eqns whose params — e.g. ctx_mesh — block serialization).
-            if name in ("scan", "while", "cond"):
+            if name in ("scan", "while", "cond", "shard_map"):
                 changed_params = {}
                 for key, val in eqn.params.items():
                     if hasattr(val, "jaxpr") and hasattr(val, "consts"):
                         inner = inline_calls(val.jaxpr, max_depth - 1)
                         if inner is not val.jaxpr:
                             changed_params[key] = type(val)(inner, val.consts)
+                    elif hasattr(val, "eqns") and hasattr(val, "invars"):
+                        # Raw (open) Jaxpr param — shard_map bodies: inline
+                        # custom_vjp/jit eqns inside so their WrappedFun
+                        # params never reach the serializer.
+                        inner = inline_calls(val, max_depth - 1)
+                        if inner is not val:
+                            changed_params[key] = inner
                     elif key == "branches" and isinstance(val, (tuple, list)):
                         new_branches = []
                         any_b = False

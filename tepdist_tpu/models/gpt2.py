@@ -140,14 +140,17 @@ def attention(block, x, cfg: GPT2Config, attn_impl=None):
         attn_impl = functools.partial(flash_attention, **kw) if kw \
             else flash_attention
     if attn_impl is not None:
-        o = attn_impl(q, k, v)
+        from jax.ad_checkpoint import checkpoint_name
+        o = checkpoint_name(attn_impl(q, k, v), "attn_out")
     else:
         scale = 1.0 / math.sqrt(hd)
         logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
         mask = jnp.tril(jnp.ones((T, T), bool))
         logits = jnp.where(mask, logits.astype(jnp.float32), -1e9)
         probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-        o = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        from jax.ad_checkpoint import checkpoint_name
+        o = checkpoint_name(
+            jnp.einsum("bhqk,bhkd->bhqd", probs, v), "attn_out")
     o = o.transpose(0, 2, 1, 3).reshape(B, T, D)
     return o @ block["attn_proj_w"] + block["attn_proj_b"]
 
@@ -164,11 +167,18 @@ def _remat_kwargs(cfg: GPT2Config) -> dict:
     if cfg.remat_policy == "dots_no_batch":
         return {"policy":
                 jax.checkpoint_policies.dots_with_no_batch_dims_saveable}
+    if cfg.remat_policy == "save_attn":
+        # Save ONLY the attention outputs (tagged checkpoint_name above):
+        # the backward skips re-running the flash kernel — the one block op
+        # XLA cannot fuse into the recompute anyway — for mb*T*D*2 bytes
+        # per layer, a fraction of what "dots" keeps.
+        return {"policy":
+                jax.checkpoint_policies.save_only_these_names("attn_out")}
     if cfg.remat_policy != "full":
         raise ValueError(
-            f"unknown remat_policy {cfg.remat_policy!r}; expected "
-            "'full', 'dots', or 'dots_no_batch' (same vocabulary as "
-            "train.py's REMAT_POLICY)")
+            f"unknown remat_policy {cfg.remat_policy!r}; expected 'full', "
+            "'dots', 'dots_no_batch', or 'save_attn' (superset of "
+            "train.py's REMAT_POLICY vocabulary)")
     return {}
 
 

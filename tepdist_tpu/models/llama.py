@@ -28,6 +28,9 @@ class LlamaConfig:
     ffn_mult: float = 2.6875      # hidden = mult * dim, rounded to 128
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
+    # "einsum" (planner-visible dots) or "flash" (pallas fused kernel,
+    # applied after RoPE + GQA head broadcast; O(T) activation memory).
+    attn: str = "einsum"
 
     @property
     def head_dim(self) -> int:
@@ -112,12 +115,16 @@ def _attention(blk, x, cfg: LlamaConfig):
     group = H // KV
     k = jnp.repeat(k, group, axis=1)
     v = jnp.repeat(v, group, axis=1)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(
-        jnp.float32) / math.sqrt(hd)
-    mask = jnp.tril(jnp.ones((T, T), bool))
-    s = jnp.where(mask, s, -1e9)
-    p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    if cfg.attn == "flash":
+        from tepdist_tpu.ops.pallas.flash_attention import flash_attention
+        o = flash_attention(q, k, v, causal=True)
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(
+            jnp.float32) / math.sqrt(hd)
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask, s, -1e9)
+        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
     o = o.transpose(0, 2, 1, 3).reshape(B, T, D)
     return o @ blk["wo"]
 

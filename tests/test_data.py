@@ -1,0 +1,112 @@
+"""Input pipeline tests: token file round-trip, window sampling, prefetch
+equivalence (reference had no dataset library — SURVEY §2.7's examples use
+FAKE_INPUT; this is the usability surplus replacing it)."""
+
+import itertools
+
+import numpy as np
+
+from tepdist_tpu.data import (
+    DevicePrefetcher,
+    TokenDataset,
+    encode_bytes,
+    pack_token_file,
+)
+
+
+def test_pack_and_sample(tmp_path):
+    toks = np.arange(10_000, dtype=np.int64) % 50257
+    path = str(tmp_path / "toks.bin")
+    pack_token_file(toks, path)
+    ds = TokenDataset(path)
+    assert len(ds) == 10_000
+    batch = ds.sample(np.random.default_rng(0), batch=4, seq=128)
+    assert batch.shape == (4, 129)
+    assert batch.dtype == np.int32
+    # Windows are contiguous slices of the source stream.
+    for row in batch:
+        start = row[0] + (0 if row[0] <= row[-1] else 0)
+        np.testing.assert_array_equal(
+            row, (np.arange(row[0], row[0] + 129) % 50257))
+
+
+def test_sampling_deterministic(tmp_path):
+    toks = np.arange(5_000) % 256
+    path = str(tmp_path / "t.bin")
+    pack_token_file(toks, path)
+    ds = TokenDataset(path)
+    a = list(itertools.islice(ds.batches(2, 64, seed=7), 3))
+    b = list(itertools.islice(ds.batches(2, 64, seed=7), 3))
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_byte_encoding_roundtrippable(tmp_path):
+    text = "hello tepdist — tpu native"
+    toks = encode_bytes(text)
+    assert bytes(toks.astype(np.uint8)).decode("utf-8") == text
+    path = str(tmp_path / "b.bin")
+    pack_token_file(np.tile(toks, 50), path)
+    ds = TokenDataset(path)
+    assert ds.sample(np.random.default_rng(0), 1, 16).shape == (1, 17)
+
+
+def test_prefetch_matches_direct(tmp_path):
+    toks = np.arange(4_000) % 512
+    path = str(tmp_path / "p.bin")
+    pack_token_file(toks, path)
+    ds = TokenDataset(path)
+    direct = list(itertools.islice(ds.batches(2, 32, seed=3), 4))
+    pre = DevicePrefetcher(itertools.islice(ds.batches(2, 32, seed=3), 4))
+    got = [np.asarray(b) for b in pre]
+    assert len(got) == 4
+    for x, y in zip(direct, got):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_prefetch_propagates_errors():
+    def bad():
+        yield np.zeros((2, 3), np.int32)
+        raise RuntimeError("source broke")
+
+    pre = DevicePrefetcher(bad())
+    next(pre)
+    try:
+        next(pre)
+    except RuntimeError as e:
+        assert "source broke" in str(e)
+    else:  # pragma: no cover
+        raise AssertionError("error not propagated")
+
+
+def test_training_on_real_tokens(tmp_path):
+    """End to end: byte-level token file -> sampler -> a few GPT-2 train
+    steps; loss decreases on repeated data."""
+    import jax
+    import optax
+
+    from tepdist_tpu.models import gpt2
+
+    text = "the quick brown fox jumps over the lazy dog. " * 200
+    path = str(tmp_path / "corpus.bin")
+    pack_token_file(encode_bytes(text), path)
+    ds = TokenDataset(path)
+
+    cfg = gpt2.GPT2Config(vocab_size=256, n_ctx=64, n_embd=64, n_layer=2,
+                          n_head=4, dtype=np.float32)
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o, t):
+        l, g = jax.value_and_grad(lambda p: gpt2.loss_fn(p, t, cfg))(p)
+        u, o = tx.update(g, o, p)
+        return l, optax.apply_updates(p, u), o
+
+    losses = []
+    it = DevicePrefetcher(itertools.islice(ds.batches(8, 32, seed=0), 8))
+    for batch in it:
+        l, params, opt = step(params, opt, batch)
+        losses.append(float(l))
+    assert losses[-1] < losses[0]

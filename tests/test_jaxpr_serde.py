@@ -348,3 +348,39 @@ def test_pallas_flash_gpt2_train_step_round_trip():
     for x, y in zip(a, b):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_ulysses_flash_inner_round_trip(devices):
+    """Sequence parallelism COMPOSED with the pallas kernel crosses the
+    wire: a shard_map body containing custom_vjp'd pallas_call eqns.
+    inline_calls now recurses into shard_map bodies so the custom_vjp
+    WrappedFun params are inlined away before serialization."""
+    from jax.sharding import Mesh
+
+    from tepdist_tpu.ops.pallas.flash_attention import flash_attention
+    from tepdist_tpu.ops.ulysses import ulysses_attention
+    from tepdist_tpu.rpc.jaxpr_serde import (
+        deserialize_closed_jaxpr,
+        serialize_closed_jaxpr,
+    )
+
+    mesh = Mesh(np.array(jax.devices()[:4]), axis_names=("seq",))
+    B, H, T, D = 2, 4, 64, 16
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (B, H, T, D))
+    k = jax.random.normal(k2, (B, H, T, D))
+    v = jax.random.normal(k3, (B, H, T, D))
+
+    def f(q, k, v):
+        return jnp.sum(ulysses_attention(q, k, v, mesh,
+                                         inner=flash_attention))
+
+    for make, tol in ((lambda: jax.make_jaxpr(f)(q, k, v), 1e-5),
+                      (lambda: jax.make_jaxpr(jax.grad(f))(q, k, v), 1e-4)):
+        closed = make()
+        rt = deserialize_closed_jaxpr(serialize_closed_jaxpr(closed))
+        a = jax.core.eval_jaxpr(closed.jaxpr, closed.consts, q, k, v)
+        b = jax.core.eval_jaxpr(rt.jaxpr, rt.consts, q, k, v)
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=tol, atol=1e-6)

@@ -346,3 +346,27 @@ def test_gpt2_chunked_cross_entropy_matches_dense(devices):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
         g_nd, g_dense)
+
+
+def test_llama_flash_attention_matches_einsum(devices):
+    """llama attn='flash' (pallas kernel after RoPE + GQA broadcast) must
+    match the einsum path; grads too. Odd T from the LM token shift takes
+    the largest-divisor default block (graceful at any T)."""
+    import dataclasses
+
+    from tepdist_tpu.models import llama
+
+    cfg = llama.CONFIGS["test"]
+    cfgf = dataclasses.replace(cfg, attn="flash")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                cfg.vocab_size)
+
+    l0, g0 = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, tokens, cfg))(params)
+    l1, g1 = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, tokens, cfgf))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=2e-3)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-2, atol=2e-4), g0, g1)
