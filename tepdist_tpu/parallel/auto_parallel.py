@@ -110,12 +110,21 @@ def plan_axes(
     topology: MeshTopology,
     annotations: Optional[Dict[int, Dict[str, DimStrategy]]] = None,
     mode: str = "cost",
+    mem_limit_bytes: Optional[float] = None,
 ) -> List[GraphStrategy]:
     """Run the per-axis planner sequence (reference: per-mesh-level
-    CostSpmdStrategy loop in RunExplorationlMode step 2)."""
+    CostSpmdStrategy loop in RunExplorationlMode step 2).
+
+    ``mem_limit_bytes``: per-device storage budget enforced INSIDE the
+    cost ILP (reference SplitPlanByMemCost integrated into the search) —
+    variable sharding (ZeRO/TP) emerges where replication would not fit,
+    with split dims chosen by the gather costs already in the objective.
+    Applies to the cost mode's whole-graph ILP; the subgraph-DP and greedy
+    paths fall back to the post-hoc ``apply_mem_save``."""
     fixed_per_axis = _resolve_fixed(graph, annotations)
     strategies: List[GraphStrategy] = []
     forbidden: Dict[Var, set] = {}
+    prior_splits: Dict[Var, int] = {}
     for name, size in topology.device_axes():
         if size <= 1:
             continue
@@ -147,13 +156,16 @@ def plan_axes(
             gs = FastSpmdStrategy(graph, name, size, fixed).run()
         else:
             gs = CostSpmdStrategy(
-                graph, name, size, fixed=fixed, forbidden_dims=forbidden
+                graph, name, size, fixed=fixed, forbidden_dims=forbidden,
+                mem_limit_bytes=mem_limit_bytes,
+                prior_var_splits=prior_splits,
             ).run()
         strategies.append(gs)
         # Later axes may not re-split dims this axis already split.
         for v, s in gs.var_strategies.items():
             if s.is_split():
                 forbidden.setdefault(v, set()).add(s.partition_dim)
+                prior_splits[v] = prior_splits.get(v, 1) * s.num_splits
     return strategies
 
 
@@ -327,7 +339,10 @@ def auto_parallel(
     if env.ignore_annotation:
         annotations = None
     graph, in_tree, out_tree = trace_graph(fn, *example_args, **example_kwargs)
-    strategies = plan_axes(graph, topology, annotations, mode)
+    if var_mem_limit is None and env.var_mem_limit > 0:
+        var_mem_limit = env.var_mem_limit
+    strategies = plan_axes(graph, topology, annotations, mode,
+                           mem_limit_bytes=var_mem_limit)
     if state_alias:
         n_aligned = align_state_storage(graph, strategies, state_alias)
         if n_aligned:
@@ -335,9 +350,10 @@ def auto_parallel(
                      n_aligned)
     state_invars = sorted({ii for ii in (state_alias or {}).values()
                            if ii >= 0})
-    if var_mem_limit is None and env.var_mem_limit > 0:
-        var_mem_limit = env.var_mem_limit
     if var_mem_limit is not None and var_mem_limit > 0:
+        # Safety net for plans from the subgraph-DP/greedy paths (the
+        # whole-graph ILP already enforced the budget in-search and this
+        # becomes a no-op there).
         apply_mem_save(graph, strategies, topology, var_mem_limit,
                        state_invars or None)
     # Param <-> optimizer-slot affinity: slots adopt their param's sharding

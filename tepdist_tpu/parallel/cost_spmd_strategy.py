@@ -147,6 +147,8 @@ class CostSpmdStrategy:
         fixed: Optional[Dict[Var, DimStrategy]] = None,
         forbidden_dims: Optional[Dict[Var, set]] = None,
         chip=None,
+        mem_limit_bytes: Optional[float] = None,
+        prior_var_splits: Optional[Dict[Var, int]] = None,
     ):
         self.graph = graph
         self.axis = axis_name
@@ -155,6 +157,16 @@ class CostSpmdStrategy:
         self.forbidden = {k: set(v) for k, v in (forbidden_dims or {}).items()}
         self.spec = chip or chip_spec()
         self.env = ServiceEnv.get()
+        # In-search memory budget (reference: SplitPlanByMemCost/MemSavePlan
+        # integrated into the cost search, cost_spmd_strategy.h:900-911):
+        # when set, the whole-graph ILP carries a storage constraint
+        # Σ bytes(v)·(replicated ? 1 : 1/n) ≤ mem_limit_bytes over the
+        # graph's storage invars, so ZeRO/TP-style variable sharding
+        # EMERGES (cheapest-gather dims win via the edge costs) instead of
+        # being a post-hoc pass. ``prior_var_splits`` scales each var's
+        # bytes by earlier axes' split factors.
+        self.mem_limit = mem_limit_bytes
+        self.prior_splits = dict(prior_var_splits or {})
 
     # ------------------------------------------------------------------
     def run(self) -> GraphStrategy:
@@ -393,17 +405,45 @@ class CostSpmdStrategy:
 
         # Variable pseudo-cones: proposals = consumer-demanded splits +
         # replicated; fixed strategies override.
+        if self.mem_limit is not None:
+            # Memory-constrained mode: EVERY storage invar must be a
+            # decision variable (vars never demanded by a cone would
+            # otherwise silently stay replicated outside the budget), and
+            # every storage var needs at least one split proposal so the
+            # budget constraint is satisfiable. Proposals on each
+            # divisible dim; the ILP's gather-cost edges pick the cheap
+            # one.
+            for v in self._storage_vars():
+                input_vars.setdefault(v, [])
         var_list = list(input_vars)
         var_props: Dict[Var, List[DimStrategy]] = {}
         for v in var_list:
             if v in self.fixed:
                 var_props[v] = [self.fixed[v]]
-            else:
-                props = [s for s in input_vars[v]
-                         if s.partition_dim not in self.forbidden.get(v, ())]
-                props.append(DimStrategy.make_replicated(self.n))
-                var_props[v] = props
+                continue
+            props = [s for s in input_vars[v]
+                     if s.partition_dim not in self.forbidden.get(v, ())]
+            if self.mem_limit is not None and not any(
+                    s.is_split() for s in props):
+                shape = getattr(v.aval, "shape", ())
+                for d in range(len(shape)):
+                    if d in self.forbidden.get(v, ()):
+                        continue
+                    if shape[d] % self.n == 0 and shape[d] >= self.n:
+                        props.append(DimStrategy.split_on(d, self.n))
+            props.append(DimStrategy.make_replicated(self.n))
+            var_props[v] = props
         return demands, var_list, var_props, var_producer_cone
+
+    def _storage_vars(self, min_bytes: float = 1 << 20) -> List[Var]:
+        """Invars that count against the memory budget: anything at least
+        ``min_bytes`` effective (after earlier axes' splits)."""
+        out = []
+        for v in self.graph.invars:
+            b = aval_bytes(v.aval) / self.prior_splits.get(v, 1)
+            if b >= min_bytes:
+                out.append(v)
+        return out
 
     def _solve(self, cones: List[InstCone]) -> Tuple[Dict[int, int], str]:
         """Pick one strategy per cone + per-variable storage shardings.
@@ -633,6 +673,13 @@ class CostSpmdStrategy:
         sharded storage on ties (ZeRO-style memory balance). The ILP leaves
         this degenerate because replicated storage serves any split demand at
         zero comm cost."""
+        if self.mem_limit is not None and getattr(
+                self, "_ilp_var_choice", None) is not None:
+            # Memory-constrained ILP: its per-var storage picks SATISFY the
+            # budget — re-deriving them from transition costs alone would
+            # un-shard vars back over the limit. Keep them verbatim.
+            self._var_choice = dict(self._ilp_var_choice)
+            return
         winning: Dict[Var, List[DimStrategy]] = {}
         for c in cones:
             for kind, _key, v, want in demands[(c.id, choice[c.id])]:
@@ -747,6 +794,30 @@ class CostSpmdStrategy:
         for v in var_list:
             idxs = [x_index[("v", id(v), si)] for si in range(len(var_props[v]))]
             rows.append((idxs, [1.0] * len(idxs), 1.0, 1.0))
+        # Memory budget (whole-graph mode): storage bytes per device after
+        # this axis must fit. Coefficient = effective bytes x (1 for a
+        # replicated choice, 1/n for a split choice).
+        if active is None and self.mem_limit is not None:
+            storage = set(self._storage_vars())
+            idxs, coefs = [], []
+            floor_bytes = 0.0
+            for v in var_list:
+                if v not in storage:
+                    continue
+                eff = aval_bytes(v.aval) / self.prior_splits.get(v, 1)
+                floor_bytes += eff / self.n
+                for si, s in enumerate(var_props[v]):
+                    idxs.append(x_index[("v", id(v), si)])
+                    coefs.append(eff if not s.is_split() else eff / self.n)
+            if idxs:
+                if floor_bytes > self.mem_limit:
+                    log.warning(
+                        "memory budget %.2e B infeasible even fully "
+                        "sharded on axis=%s (floor %.2e B); constraint "
+                        "dropped", self.mem_limit, self.axis, floor_bytes)
+                else:
+                    rows.append((idxs, coefs, -np.inf, float(self.mem_limit)))
+
         # Boundary forcing: the producer must emit the demanded strategy.
         for v, want_sig in (force or {}).items():
             cp = var_producer_cone[v]
@@ -851,6 +922,10 @@ class CostSpmdStrategy:
                     v = var_pos[key[1]]
                     var_choice[v] = var_props[v][key[2]]
         self._var_choice = var_choice
+        if active is None:
+            # Whole-graph solve: remember for _finalize_var_choice (the
+            # memory-constrained picks must survive finalization).
+            self._ilp_var_choice = dict(var_choice)
         return choice, float(res.fun)
 
     def _export_ilp(self, x_index, obj, rows) -> None:

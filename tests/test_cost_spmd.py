@@ -5,7 +5,11 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+import numpy as np
+
 from tepdist_tpu.core.dist_spec import DimStrategy
+from tepdist_tpu.core.mesh import MeshTopology
+from tepdist_tpu.parallel.auto_parallel import plan_axes
 from tepdist_tpu.graph.jaxpr_graph import trace_graph
 from tepdist_tpu.parallel.cost_spmd_strategy import (
     CostSpmdStrategy,
@@ -184,3 +188,67 @@ def test_graph_strategy_carries_comm_cost():
     gs = plan_axes(graph, MeshTopology([("data", 8)]))[0]
     assert gs.comm_cost is not None
     assert 0.0 <= gs.comm_cost <= gs.total_cost + 1e-12
+
+
+def test_memory_budget_forces_storage_sharding():
+    """In-ILP memory budget (reference: SplitPlanByMemCost/MemSavePlan
+    INSIDE the cost search, cost_spmd_strategy.h:900-911): without a
+    budget, DP replicates weights; with a budget of half the storage, the
+    whole-graph ILP shards enough variable storage to fit, choosing dims
+    via the gather costs already in the objective."""
+    from tepdist_tpu.graph.cost import aval_bytes
+
+    graph, _ = _mlp_grad_graph(batch=512, din=2048, dh=2048, dout=2048)
+    total = sum(aval_bytes(v.aval) for v in graph.invars)
+
+    gs = plan_axes(graph, MeshTopology([("data", 4)]))[0]
+    n_split = sum(1 for v in graph.invars
+                  if (s := gs.var_strategies.get(v)) is not None
+                  and s.is_split())
+    # Pure DP: nothing needs to shard (x may or may not; weights must not).
+
+    budget = total / 2
+    gs2 = plan_axes(graph, MeshTopology([("data", 4)]),
+                    mem_limit_bytes=budget)[0]
+    per_dev = sum(
+        aval_bytes(v.aval) / (s.num_splits if (
+            s := gs2.var_strategies.get(v)) is not None and s.is_split()
+            else 1)
+        for v in graph.invars)
+    assert per_dev <= budget * 1.01
+    n_split2 = sum(1 for v in graph.invars
+                   if (s := gs2.var_strategies.get(v)) is not None
+                   and s.is_split())
+    assert n_split2 > n_split
+
+
+def test_memory_budget_plan_executes_correctly(devices):
+    """A memory-constrained plan must still match unsharded numerics —
+    GSPMD inserts the gathers for sharded storage consumed replicated."""
+    import optax
+
+    from tepdist_tpu.graph.cost import aval_bytes
+    from tepdist_tpu.parallel.auto_parallel import auto_parallel
+
+    def loss(params, x, y):
+        h = x
+        for i, w in enumerate(params):
+            h = jnp.tanh(h @ w) if i < len(params) - 1 else h @ w
+        return jnp.mean((h - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    ks = jax.random.split(k, 6)
+    params = [jax.random.normal(ks[i], (256, 256)) * 0.05 for i in range(4)]
+    x = jax.random.normal(ks[4], (64, 256))
+    y = jax.random.normal(ks[5], (64, 256))
+
+    total = sum(aval_bytes(jax.core.get_aval(p)) for p in params)
+    plan = auto_parallel(jax.value_and_grad(loss),
+                         MeshTopology([("data", 8)]), params, x, y,
+                         var_mem_limit=int(total / 2))
+    l_ref, g_ref = jax.value_and_grad(loss)(params, x, y)
+    l, g = plan.step(params, x, y)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l_ref), rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5), g, g_ref)
