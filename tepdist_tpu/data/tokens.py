@@ -67,10 +67,10 @@ class TokenDataset:
         """[batch, seq+1] int32 windows drawn uniformly (with replacement,
         the standard LM pretraining regime)."""
         n = len(self.tokens) - (seq + 1)
-        if n <= 0:
+        if n < 0:
             raise ValueError(
                 f"dataset has {len(self.tokens)} tokens < seq+1={seq + 1}")
-        starts = rng.integers(0, n, size=batch)
+        starts = rng.integers(0, n + 1, size=batch)
         return np.stack([self.tokens[s:s + seq + 1] for s in starts]
                         ).astype(np.int32)
 

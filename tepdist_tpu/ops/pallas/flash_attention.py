@@ -259,12 +259,29 @@ def _default_block(T: int) -> int:
     fewer grid steps, fewer LSE/accumulator round-trips, and longer MXU
     bursts; 1024 tiles regress (VMEM pressure). 512 caps the S-block at
     512*512*4B = 1 MiB of VMEM, safe alongside K/V for any practical D.
-    Must DIVIDE T (grid constraint) — LM losses routinely produce odd T
-    via token shifting, where this degrades gracefully (worst case 1)."""
-    for b in range(min(T, 512), 0, -1):
-        if T % b == 0:
-            return b
-    return 1
+    Must DIVIDE T (grid constraint). Mosaic wants lane-aligned tiles, so
+    only multiples of 128 (ideal) or 8 (acceptable) are returned; an
+    awkward T (prime, 3*11*31, ...) gets None and the caller falls back
+    to the einsum path rather than silently emitting 341- or 1-wide
+    blocks that mis-tile the MXU."""
+    for step in (128, 8):
+        for b in range(min(T, 512) // step * step, 0, -step):
+            if T % b == 0:
+                return b
+    return None
+
+
+def _dense_attention(q, k, v, causal: bool, scale: float):
+    """Einsum fallback for seq lens no lane-aligned tile divides."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        T = q.shape[2]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
 
 
 def flash_attention(q, k, v, causal: bool = True,
@@ -275,8 +292,11 @@ def flash_attention(q, k, v, causal: bool = True,
     """q, k, v: [B, H, T, D] -> [B, H, T, D]. Differentiable (custom VJP)."""
     B, H, T, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
-    block_q = min(block_q or _default_block(T), T)
-    block_k = min(block_k or _default_block(T), T)
+    default = _default_block(T)
+    if default is None and (block_q is None or block_k is None):
+        return _dense_attention(q, k, v, causal, scale)
+    block_q = min(block_q or default, T)
+    block_k = min(block_k or default, T)
     if T % block_q or T % block_k:
         raise ValueError(f"seq len {T} must divide blocks {block_q}/{block_k}")
     if interpret is None:

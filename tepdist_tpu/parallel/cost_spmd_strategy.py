@@ -805,10 +805,16 @@ class CostSpmdStrategy:
                 if v not in storage:
                     continue
                 eff = aval_bytes(v.aval) / self.prior_splits.get(v, 1)
-                floor_bytes += eff / self.n
-                for si, s in enumerate(var_props[v]):
+                v_coefs = [eff if not s.is_split() else eff / self.n
+                           for s in var_props[v]]
+                # True per-var minimum: a fixed-replicated var (or one with
+                # no divisible dim) only offers `eff`, not eff/n — using
+                # eff/n here would admit an infeasible constraint and fail
+                # the whole ILP instead of dropping this row.
+                floor_bytes += min(v_coefs) if v_coefs else eff
+                for si in range(len(var_props[v])):
                     idxs.append(x_index[("v", id(v), si)])
-                    coefs.append(eff if not s.is_split() else eff / self.n)
+                    coefs.append(v_coefs[si])
             if idxs:
                 if floor_bytes > self.mem_limit:
                     log.warning(

@@ -28,6 +28,19 @@ import jax
 from jax.extend import core as jexcore
 from jax._src import core as _core
 
+import logging
+log = logging.getLogger(__name__)
+
+# Prims whose equations can carry jax effects (Ref read/write inside pallas
+# kernels; state primitives; control flow that propagates inner effects).
+# Everything else is effect-free in serializable programs and keeps
+# no_effects without re-running abstract_eval on decode.
+_EFFECTFUL_PRIMS = frozenset({
+    "pallas_call", "scan", "while", "cond", "pjit", "closed_call",
+    "core_call", "custom_vjp_call", "custom_jvp_call", "shard_map",
+    "get", "swap", "addupdate",
+})
+
 
 # --------------------------------------------------------------------------
 # Primitive registry
@@ -520,16 +533,20 @@ def _decode_jaxpr_struct(d: dict):
         # Recompute the eqn's effects (Ref read/write effects inside pallas
         # kernels, and their propagation through while/scan/cond/jit):
         # effects aren't serialized — abstract_eval re-derives them from the
-        # decoded avals+params. Prims whose abstract_eval needs ambient
-        # context we can't reproduce here keep no_effects (the pre-pallas
-        # behaviour, correct for all effect-free lax prims).
+        # decoded avals+params. Only prims that can actually carry effects
+        # are re-evaluated: effect-free lax prims keep no_effects without
+        # paying abstract_eval (scan/shard_map bodies are expensive), and a
+        # genuine decode error in a plain prim can't hide behind a blanket
+        # except here.
         effects = _core.no_effects
-        try:
-            out = prim.abstract_eval(*[x.aval for x in inv], **params)
-            if isinstance(out, tuple) and len(out) == 2:
-                effects = out[1]
-        except Exception:
-            pass
+        if prim.name in _EFFECTFUL_PRIMS:
+            try:
+                out = prim.abstract_eval(*[x.aval for x in inv], **params)
+                if isinstance(out, tuple) and len(out) == 2:
+                    effects = out[1]
+            except Exception as exc:
+                log.debug("effects re-derivation failed for %s: %s",
+                          prim.name, exc)
         eqns.append(_core.new_jaxpr_eqn(
             inv, outv, prim, params, effects=effects, ctx=ctx))
     outvars = [dec_atom(a) for a in d["outvars"]]

@@ -393,6 +393,12 @@ class TepdistServicer:
         self.plan_gen = int(header.get("plan_gen", self.plan_gen + 1))
         if header.get("plan_meta"):
             self.worker_plan = WorkerPlan(self, tasks, header["plan_meta"])
+        else:
+            # A coordinator-style dispatch (tasks only, no plan_meta) must
+            # not leave a stale WorkerPlan bound to the old aborted store:
+            # its recv waits would hang until timeout while new pushes land
+            # in the fresh store above.
+            self.worker_plan = None
         return protocol.pack({"ok": True, "n_tasks": len(tasks)})
 
     def ExecuteRemotePlan(self, request: bytes, context=None) -> bytes:
