@@ -294,6 +294,20 @@ def flash_attention(q, k, v, causal: bool = True,
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     default = _default_block(T)
     if default is None and (block_q is None or block_k is None):
+        if causal:
+            # Pad T up to the next multiple of 128 and slice the result:
+            # under the causal mask real queries (pos < T) never attend
+            # padded keys (pos >= T), and padded query rows are sliced
+            # off (their cotangents are zero), so numerics are exact and
+            # memory stays O(T*block) instead of the dense O(T^2).
+            Tp = -(-T // 128) * 128
+            pad = ((0, 0), (0, 0), (0, Tp - T), (0, 0))
+            out = flash_attention(
+                jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+                causal=True, scale=scale, interpret=interpret)
+            return out[:, :, :T, :]
+        # Non-causal: padded keys would be attended; dense is the only
+        # exact fallback (rare — awkward T with bidirectional attention).
         return _dense_attention(q, k, v, causal, scale)
     block_q = min(block_q or default, T)
     block_k = min(block_k or default, T)

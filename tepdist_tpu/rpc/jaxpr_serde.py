@@ -38,7 +38,13 @@ log = logging.getLogger(__name__)
 _EFFECTFUL_PRIMS = frozenset({
     "pallas_call", "scan", "while", "cond", "pjit", "closed_call",
     "core_call", "custom_vjp_call", "custom_jvp_call", "shard_map",
-    "get", "swap", "addupdate",
+    "remat2", "checkpoint",
+    # state / pallas kernel-side primitives (the registry registers
+    # jax._src.state.primitives and jax._src.pallas.primitives)
+    "get", "swap", "addupdate", "masked_swap",
+    "atomic_rmw", "atomic_cas", "run_scoped",
+    "semaphore_signal", "semaphore_wait", "semaphore_read",
+    "debug_print", "debug_callback",
 })
 
 
