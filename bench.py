@@ -558,12 +558,14 @@ def main() -> None:
         stale = _load_stale_tpu_headline()
         if stale is not None:
             print(json.dumps(stale), flush=True)
+            headline_record = stale
         else:
             # No TPU headline ever recorded: the round-1 tiny-config CPU
             # line keeps the harness runnable anywhere.
             line = bench_gpt2_117m(on_tpu=False)
             print(json.dumps({k: line[k] for k in
                               ("metric", "value", "unit", "vs_baseline")}))
+            headline_record = line
         # The pinned runtime protocol is backend-independent (own CPU
         # subprocess) — still record it this round so bench_extra.json
         # isn't a previous round's leftovers.
@@ -577,7 +579,7 @@ def main() -> None:
         try:
             tmp = f"{EXTRA_FILE}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
-                json.dump({"extra": extra, "headline": stale,
+                json.dump({"extra": extra, "headline": headline_record,
                            "headline_error": None}, f, indent=1)
             os.replace(tmp, EXTRA_FILE)
         except Exception:

@@ -25,6 +25,9 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
     ("IGNORE_ANNOTATION", bool, False, "ignore user sharding annotations"),
     ("AUX_AFFINITY", bool, True, "variable<->optimizer-state affinity terms in ILP"),
     ("COST_FACTOR", float, 1.0, "scale factor on comm costs"),
+    ("COMM_OVERLAP", float, 0.3, "fraction of collective time hidden under "
+     "compute (XLA async collectives); evaluator prices exposed_comm = "
+     "(1 - COMM_OVERLAP) * comm"),
     ("FP16_COMM", bool, False, "compress gradient all-reduce to bf16 [tpu: bf16]"),
     ("NUM_GRADIENTS", int, -1, "compat: gradients are detected structurally"),
     ("FORWARD_SUB_GRAPH_NUM", int, -1, "compat alias: see SUBGRAPH_NODES"),

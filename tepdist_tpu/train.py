@@ -194,6 +194,11 @@ def explore_parallelism(
                     gs_d = plan_axes(graph, MeshTopology([("data", d)]),
                                      None, "cost")[0]
                     comm += gs_d.comm_cost or 0.0
+                # Same COMM_OVERLAP discount the Evaluator applies to the
+                # rival SPMD candidates — hand-priced candidates must not
+                # compete with undiscounted serial comm in the same argmin.
+                overlap = min(max(ServiceEnv.get().comm_overlap, 0.0), 1.0)
+                comm *= (1.0 - overlap)
                 compute_t = PerfUtils.compute_time(
                     graph.total_flops() / n_devices, spec)
                 from tepdist_tpu.graph.cost import aval_bytes as _ab

