@@ -172,6 +172,53 @@ class TepdistSession:
         return float(np.asarray(loss))
 
     # ------------------------------------------------------------------
+    def compile_generate(self, gen_fn: Callable, params,
+                         *example_args) -> Dict:
+        """Trace + ship an inference/sampling function that reads the
+        SERVER-HELD weights (reference: predict_fns.py — predictions run
+        on the estimator's trained weights, nothing is fetched).
+
+        ``gen_fn(params, *args) -> tokens``; ``params`` must have the SAME
+        leaf order as the training step's (store indices 0..n_params-1 —
+        the invariant compile_train_step established). ``example_args``
+        (prompt, key, ...) ride inline per ``generate`` call. Rule-mode
+        planning: a decode scan is bandwidth-bound; the cost ILP buys
+        nothing over the training plan's sharding."""
+        closed, out_shape = jax.make_jaxpr(gen_fn, return_shape=True)(
+            params, *example_args)
+        assert self.handle is not None, "compile_train_step first"
+        n_params = len(jax.tree_util.tree_leaves(params))
+        assert n_params == self._n_params, (
+            f"gen_fn params have {n_params} leaves; the training step "
+            f"registered {self._n_params}")
+        n_args = len(jax.tree_util.tree_leaves(example_args))
+        resp = self.client.build_execution_plan(
+            serialize_closed_jaxpr(closed),
+            mesh_axes=self.mesh_axes,
+            variable_indices=list(range(n_params)),
+            state_alias={},
+            mode="rule",
+        )
+        self._gen_handle = resp["handle"]
+        self._gen_arg_idx = list(range(n_params, n_params + n_args))
+        self._gen_out_tree = jax.tree_util.tree_structure(out_shape)
+        return resp["summary"]
+
+    def generate(self, *args):
+        """Run the compiled sampler on the server's current weights and
+        return the decoded tokens."""
+        assert getattr(self, "_gen_handle", None) is not None, \
+            "compile_generate first"
+        leaves = jax.tree_util.tree_leaves(args)
+        inline = {idx: np.asarray(v)
+                  for idx, v in zip(self._gen_arg_idx, leaves)}
+        result = self.client.execute_plan(self._gen_handle,
+                                          inline_args=inline,
+                                          inference=True)
+        return jax.tree_util.tree_unflatten(
+            self._gen_out_tree, [np.asarray(o) for o in result["outputs"]])
+
+    # ------------------------------------------------------------------
     def run_async(self, *batch):
         """Pipelined step submission (reference: the optional async RPC path
         bounded by a semaphore — num_parallel_rpc_steps, xla_ops.h:229-232).

@@ -35,7 +35,9 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
      "cuts into subgraphs + DP (reference FindSubGraphs; 0 = whole-graph ILP"
      " always)"),
     ("SUBGRAPH_BEAM", int, 3, "beam width over boundary-strategy states in "
-     "subgraph DP"),
+     "subgraph DP; data-picked (tests/test_subgraph_dp.py beam curve: "
+     "beam=2 already exact on transformer grad graphs with lookahead, "
+     "3 = +1 margin)"),
     ("SUBGRAPH_WIDTH", int, 4, "max interface vars for the forced-boundary "
      "DP variant (wider interfaces: natural variant only)"),
     ("VAR_MEM_LIMIT", int, -1, "per-device variable bytes before ZeRO splitting"),

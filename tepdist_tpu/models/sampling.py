@@ -1,0 +1,152 @@
+"""Incremental decoding (KV cache) + sampling for the GPT-2 family.
+
+Reference parity: examples/GPT2/predict_fns.py + models/gpt2/sample.py —
+`sample_sequence` with a `past` cache, temperature, top-k truncation and
+multinomial sampling inside a while_loop. TPU redesign: static-shape KV
+cache ([n_layer, B, H, max_len, head_dim], written with
+`lax.dynamic_update_slice`), one `lax.scan` over decode steps so the whole
+prefill+decode is ONE compiled program (no per-token dispatch), fp32
+logits, `jax.random.categorical` for the multinomial draw. Runs under any
+GSPMD sharding of the weights (TP decode) — the cache carries the batch
+dim for DP.
+
+Serializable: einsum attention only (no pallas) — a decode step is
+bandwidth-bound, not MXU-bound, so flash buys nothing at S=1 — which also
+lets the sampler ship over RPC and run on server-held sharded weights
+(client/session.py compile_generate / examples/GPT2/generate.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tepdist_tpu.models import gpt2
+from tepdist_tpu.models.gpt2 import GPT2Config, _layer_norm
+
+_NEG_INF = -1e30
+
+
+def init_cache(cfg: GPT2Config, batch: int, max_len: int) -> Dict[str, Any]:
+    shape = (cfg.n_layer, batch, cfg.n_head, max_len, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _attn_with_cache(block, x, ck, cv, start, cfg: GPT2Config):
+    """Causal attention of a length-S query block at positions
+    [start, start+S) against the (updated) cache. ck/cv: [B, H, L, hd].
+    `start` may be traced (decode) or 0 (prefill)."""
+    B, S, D = x.shape
+    H, hd = cfg.n_head, cfg.head_dim
+    qkv = x @ block["attn_qkv_w"] + block["attn_qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, start, 0))
+    cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, start, 0))
+    L = ck.shape[2]
+    scale = 1.0 / math.sqrt(hd)
+    s = jnp.einsum("bhsd,bhld->bhsl", q.astype(jnp.float32),
+                   ck.astype(jnp.float32)) * scale
+    q_pos = start + lax.broadcasted_iota(jnp.int32, (S, L), 0)
+    k_pos = lax.broadcasted_iota(jnp.int32, (S, L), 1)
+    s = jnp.where((k_pos <= q_pos)[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
+    o = jnp.einsum("bhsl,bhld->bhsd", p, cv)
+    o = o.transpose(0, 2, 1, 3).reshape(B, S, D)
+    return o @ block["attn_proj_w"] + block["attn_proj_b"], ck, cv
+
+
+def _forward_with_cache(params, tokens, cache, start, cfg: GPT2Config):
+    """tokens [B, S] at positions [start, start+S) -> (last-position
+    logits [B, vocab] fp32, updated cache)."""
+    B, S = tokens.shape
+    pos = start + jnp.arange(S)
+    x = (params["wte"][tokens] + params["wpe"][pos]).astype(cfg.dtype)
+    new_k, new_v = [], []
+    for i in range(cfg.n_layer):
+        blk = params[f"h{i}"]
+        a, ck, cv = _attn_with_cache(
+            blk, _layer_norm(x, blk["ln1_g"], blk["ln1_b"]),
+            cache["k"][i], cache["v"][i], start, cfg)
+        x = x + a
+        x = x + gpt2.mlp(blk, _layer_norm(x, blk["ln2_g"], blk["ln2_b"]))
+        new_k.append(ck)
+        new_v.append(cv)
+    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    x = _layer_norm(x[:, -1], params["ln_f_g"], params["ln_f_b"])
+    logits = (x @ params["wte"].T).astype(jnp.float32)
+    return logits, cache
+
+
+def _split_data(kd):
+    """split() over raw uint32 key data (serializable carry form)."""
+    k = jax.random.wrap_key_data(kd, impl="threefry2x32")
+    a, b = jax.random.split(k)
+    return jax.random.key_data(a), jax.random.key_data(b)
+
+
+def _pick(logits, sub_kd, temperature: float, top_k: int, greedy: bool):
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        kth = lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, _NEG_INF, logits)
+    key = jax.random.wrap_key_data(sub_kd, impl="threefry2x32")
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def sample(params, prompt, cfg: GPT2Config, *, max_new_tokens: int,
+           temperature: float = 1.0, top_k: int = 0, greedy: bool = False,
+           key: Optional[jax.Array] = None):
+    """prompt int32 [B, T] -> int32 [B, T + max_new_tokens].
+
+    Greedy (`greedy=True`) or temperature/top-k multinomial (the reference
+    sample_sequence's knobs). One traced program: prefill fills the cache
+    for the prompt, a `lax.scan` decodes `max_new_tokens` steps."""
+    B, T = prompt.shape
+    L = T + max_new_tokens
+    if L > cfg.n_ctx:
+        raise ValueError(f"{L} tokens > n_ctx={cfg.n_ctx}")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    cache = init_cache(cfg, B, L)
+    logits, cache = _forward_with_cache(params, prompt, cache, 0, cfg)
+    # The scan carry holds the RNG as RAW uint32 key data, not a typed
+    # key<fry> array — typed-key avals don't serialize, and the sampler
+    # must ship over RPC (greedy threads no RNG at all).
+    if greedy:
+        kd = jnp.zeros((0,), jnp.uint32)
+        sub = None
+    else:
+        if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+            key = jax.random.key_data(key)
+        key, sub = _split_data(key)
+    tok = _pick(logits, sub, temperature, top_k, greedy)
+
+    def body(carry, _):
+        cache, tok, pos, kd = carry
+        logits, cache = _forward_with_cache(
+            params, tok[:, None], cache, pos, cfg)
+        sub = None
+        if not greedy:
+            kd, sub = _split_data(kd)
+        nxt = _pick(logits, sub, temperature, top_k, greedy)
+        return (cache, nxt, pos + 1, kd), tok
+
+    kd0 = kd if greedy else key
+    (_, last, _, _), toks = lax.scan(
+        body, (cache, tok, jnp.int32(T), kd0), None,
+        length=max_new_tokens - 1) if max_new_tokens > 1 else (
+        (cache, tok, None, kd0), jnp.zeros((0, B), jnp.int32))
+    gen = jnp.concatenate(
+        [toks.T, last[:, None]], axis=1) if max_new_tokens > 1 else (
+        tok[:, None])
+    return jnp.concatenate([prompt, gen], axis=1)

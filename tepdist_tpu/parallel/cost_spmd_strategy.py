@@ -545,15 +545,21 @@ class CostSpmdStrategy:
                         last_cons[v] = p
 
         # Target ~2000-node segments (small enough for sub-second ILPs);
-        # small over-threshold graphs get ~8 segments. Cross-boundary edges
-        # are priced exactly from the accumulated choices, so cuts need no
-        # width restriction — width only caps the forced-boundary variant.
+        # small over-threshold graphs get ~8 segments. Sizing counts CONE
+        # MEMBERS — the accumulation metric below — not graph nodes: on
+        # transformer graphs most nodes are glue outside any cone, and a
+        # graph-node-based target used to swallow every cone into one
+        # segment, silently degrading forced-DP runs to the whole-graph
+        # ILP. Cross-boundary edges are priced exactly from the
+        # accumulated choices, so cuts need no width restriction — width
+        # only caps the forced-boundary variant.
+        total_members = sum(len(c.members) for c in order)
         thresh = env.subgraph_nodes if env.subgraph_nodes > 0 else 20000
         if force_segments:
-            nodes_per_seg = max(1, len(self.graph.nodes) // force_segments)
+            nodes_per_seg = max(1, total_members // force_segments)
         else:
-            nodes_per_seg = max(1, min(thresh // 8, 2500),
-                                min(2500, len(self.graph.nodes) // 8))
+            nodes_per_seg = max(1, min(2500,
+                                       max(total_members // 8, thresh // 8)))
         segments: List[List] = []
         cur: List = []
         cur_nodes = 0
@@ -578,32 +584,76 @@ class CostSpmdStrategy:
                 return None          # producer in a LATER segment: unpriced
             return cones[key].strategies[qi].internal_out.get(v)
 
+        def committed_cost(seg, seg_ids, choice_all, choice0) -> float:
+            """Exact incremental cost of THIS segment's committed choices:
+            self costs + upstream cross edges + intra-segment edges + the
+            cheapest-storage var edges. Used as the DP accumulator instead
+            of the (lookahead-contaminated) ILP objective."""
+            inc = 0.0
+            for c in seg:
+                pi = choice_all.get(c.id)
+                if pi is None:
+                    continue
+                inc += c.strategies[pi].self_cost
+                for kind, key, v, want in demands[(c.id, pi)]:
+                    b = aval_bytes(v.aval)
+                    if kind == "cone":
+                        if key in seg_ids:
+                            qi = choice_all.get(key)
+                            src = (cones[key].strategies[qi]
+                                   .internal_out.get(v)
+                                   if qi is not None else None)
+                        else:
+                            src = src_of(choice0, key, v)
+                        inc += transition_cost(src, want, b, self.n,
+                                               self.spec)
+                    elif v in self.fixed:
+                        inc += transition_cost(self.fixed[v], want, b,
+                                               self.n, self.spec)
+                    else:
+                        props = var_props.get(v) or []
+                        if props:
+                            inc += min(
+                                transition_cost(s, want, b, self.n,
+                                                self.spec) for s in props)
+            return inc
+
         # states: list of (acc_cost, choice {cid: pi})
         states: List[Tuple[float, Dict[int, int]]] = [(0.0, {})]
         seg_start = 0
         for si, seg in enumerate(segments):
             seg_start += len(seg)
             seg_ids = {c.id for c in seg}
-            # Restrict the var pseudo-cones to this segment's demands (the
+            # ONE-SEGMENT LOOKAHEAD: the segment ILP also models the next
+            # segment's cones, so boundary strategies are chosen knowing
+            # how downstream will consume them (the r2 beam saturated at a
+            # 161% gap on transformer grad graphs precisely because no
+            # enumerated boundary variant matched the global optimum).
+            # Only THIS segment's choices are committed; the next segment
+            # re-decides its own under its own lookahead.
+            next_seg = segments[si + 1] if si + 1 < len(segments) else []
+            ctx = list(seg) + list(next_seg)
+            ctx_ids = {c.id for c in ctx}
+            # Restrict the var pseudo-cones to the context's demands (the
             # global list would bloat every segment ILP).
-            seg_vars = {v for c in seg for pi in range(len(c.strategies))
+            seg_vars = {v for c in ctx for pi in range(len(c.strategies))
                         for kind, _k, v, _w in demands[(c.id, pi)]
                         if kind == "var"}
             seg_var_list = [v for v in var_list if v in seg_vars]
             # Vars this segment produces that the NEXT segment consumes:
             # the head/tail interface of the reference's SubGraphStrategy.
-            next_end = seg_start + (len(segments[si + 1])
-                                    if si + 1 < len(segments) else 0)
+            next_end = seg_start + len(next_seg)
             out_vars = [v for v, fc in first_cons.items()
                         if var_producer_cone[v] in seg_ids
                         and seg_start <= fc < next_end]
-            # Cross-boundary edges of this segment (state-independent part).
+            # Cross-boundary edges INTO the context window from already-
+            # committed segments (state-dependent constants).
             cross_edges: List[Tuple[Tuple[int, int], int, Var,
                                     DimStrategy, float]] = []
-            for c in seg:
+            for c in ctx:
                 for pi in range(len(c.strategies)):
                     for kind, key, v, want in demands[(c.id, pi)]:
-                        if kind == "cone" and key not in seg_ids:
+                        if kind == "cone" and key not in ctx_ids:
                             cross_edges.append(((c.id, pi), key, v, want,
                                                 aval_bytes(v.aval)))
             # Vars still live past this segment's end: the beam dedup key
@@ -641,13 +691,17 @@ class CostSpmdStrategy:
                     else:
                         sub_choice, obj = self._solve_ilp(
                             cones, demands, seg_var_list, var_props,
-                            active=seg, extra_cost=extra, force=force,
+                            active=ctx, extra_cost=extra, force=force,
                             var_producer_cone=var_producer_cone)
                         solve_cache[ck] = (sub_choice, obj)
                     if sub_choice is None:
                         continue
+                    # Commit only THIS segment's cones — the lookahead
+                    # segment's choices were context, not decisions.
+                    committed = {cid: pi for cid, pi in sub_choice.items()
+                                 if cid in seg_ids}
                     nchoice = dict(choice0)
-                    nchoice.update(sub_choice)
+                    nchoice.update(committed)
                     # Dedup on ALL still-live interface strategies, not just
                     # the next segment's — a skip edge first consumed two
                     # segments later must keep its states distinct.
@@ -655,7 +709,8 @@ class CostSpmdStrategy:
                         (id(v), hash(_strategy_sig(
                             src_of(nchoice, var_producer_cone[v], v))))
                         for v in set(out_vars) | set(live_vars)))
-                    cand = (acc_cost + obj, nchoice)
+                    inc = committed_cost(seg, seg_ids, nchoice, choice0)
+                    cand = (acc_cost + inc, nchoice)
                     if keyb not in new_states or cand[0] < new_states[keyb][0]:
                         new_states[keyb] = cand
             if not new_states:

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Sequence
 
 from tepdist_tpu.core.dist_spec import DimStrategy
 from tepdist_tpu.core.mesh import MeshTopology
+from tepdist_tpu.core.service_env import ServiceEnv
 from tepdist_tpu.graph.cost import aval_bytes
 from tepdist_tpu.graph.jaxpr_graph import JaxprGraph
 from tepdist_tpu.parallel.cost_spmd_strategy import (
@@ -69,7 +70,8 @@ class Evaluator:
         self.usage_ratio = usage_ratio
 
     # -- SPMD ------------------------------------------------------------
-    def _reshard_time(self, graph: JaxprGraph, gs: GraphStrategy) -> float:
+    def _reshard_time(self, graph: JaxprGraph, gs: GraphStrategy,
+                      produced: Optional[Dict] = None) -> float:
         """Price reshard edges for one axis: each node's input demand
         (back-inferred from its chosen output strategy) vs what the
         producer actually emits (reference: the reshard CustomCollectives
@@ -79,7 +81,8 @@ class Evaluator:
 
         from tepdist_tpu.parallel.strategy_utils import StrategyUtil
 
-        produced = self._produced_map(graph, gs)
+        if produced is None:
+            produced = self._produced_map(graph, gs)
         t = 0.0
         for node in graph.nodes:
             outs = gs.node_out.get(node.id)
@@ -122,6 +125,65 @@ class Evaluator:
                     produced[ov] = s
         return produced
 
+    def derived_comm(self, graph: JaxprGraph, gs: GraphStrategy,
+                     produced: Optional[Dict] = None) -> float:
+        """Collective seconds of one axis's plan, re-derived from the final
+        strategy assignment — psums at partial-resolution frontiers +
+        reshard edges — with the planner's own comm_cost as a lower bound.
+        The ONE pricing used for every candidate in an exploration argmin
+        (rule-mode, cost-mode, and the hand-priced seq hybrids in
+        train.py) so candidate kinds never compete under different
+        rulers."""
+        from jax.extend.core import Var
+
+        cost_factor = ServiceEnv.get().cost_factor
+        if produced is None:
+            produced = self._produced_map(graph, gs)
+        # Partial-ness propagates through linear ops; GSPMD inserts the ONE
+        # physical psum where the partial chain RESOLVES (a consumer whose
+        # outputs are non-partial, or the graph boundary). Charging at
+        # origination instead double-charges e.g. tied-embedding grads
+        # (add of two partial contributions = one psum of the sum).
+        consumers: Dict = {}
+        for node in graph.nodes:
+            for a in node.invars:
+                if isinstance(a, Var):
+                    consumers.setdefault(a, []).append(node)
+        outvar_set = {a for a in graph.outvars if isinstance(a, Var)}
+        coll = 0.0
+        for nid, outs in gs.node_out.items():
+            node = graph.nodes[nid]
+            for ov, s in zip(node.outvars, outs):
+                if s is None or not s.partial:
+                    continue
+                resolved = ov in outvar_set
+                if not resolved:
+                    for cons in consumers.get(ov, []):
+                        couts = gs.node_out.get(cons.id)
+                        if couts is None or not any(
+                                cs is not None and cs.partial
+                                for cs in couts):
+                            resolved = True
+                            break
+                if resolved:
+                    coll += cost_factor * PerfUtils.all_reduce_cost(
+                        aval_bytes(ov.aval), gs.num_splits, self.spec)
+        if gs.reshard_edges:
+            # Rule-mode plans record their reshard decisions explicitly
+            # (FastSpmdStrategy Solution edges) — price those directly.
+            for nid, posmap in gs.reshard_edges.items():
+                node = graph.nodes[nid]
+                for pos, (src, want) in posmap.items():
+                    if src.partial:
+                        continue       # partial->psum priced above already
+                    a = node.invars[pos]
+                    coll += transition_cost(
+                        src, want, aval_bytes(a.aval), gs.num_splits,
+                        self.spec)
+        else:
+            coll += self._reshard_time(graph, gs, produced)
+        return max(coll, gs.comm_cost or 0.0)
+
     def run(self, graph: JaxprGraph,
             strategies: Sequence[GraphStrategy],
             num_micro_batches: int = 1) -> Cost:
@@ -154,53 +216,15 @@ class Evaluator:
             compute_t += PerfUtils.compute_time(node.flops / div, self.spec)
 
         # Collective time: ALWAYS re-derived from the final strategy
-        # assignment. The cost planner's own comm_cost is its ILP
+        # assignment (derived_comm — psums at partial-resolution frontiers
+        # + reshard edges). The cost planner's own comm_cost is its ILP
         # objective view, which misses everything decided OUTSIDE the
         # cones (glue-node conflicts GSPMD resolves at runtime, partial
         # grads resolved at the apply boundary) — trusting it verbatim
         # reported comm=0 for plans whose measured step is comm-dominated.
-        # It is kept only as a lower bound on the re-derivation.
-        from tepdist_tpu.core.service_env import ServiceEnv
-        cost_factor = ServiceEnv.get().cost_factor
-        coll_t = 0.0
-        for gs in strategies:
-            produced = self._produced_map(graph, gs)
-            gs_coll = 0.0
-            for nid, outs in gs.node_out.items():
-                node = graph.nodes[nid]
-                # Partial-ness propagates through linear ops; the ONE
-                # physical psum is charged where it ORIGINATES (no partial
-                # input), not at every node it flows through — otherwise a
-                # matmul->bias->scale chain prices 3 all-reduces for one.
-                inherited = any(
-                    isinstance(a, Var)
-                    and (st := produced.get(a)) is not None and st.partial
-                    for a in node.invars)
-                if inherited:
-                    continue
-                for ov, s in zip(node.outvars, outs):
-                    if s is not None and s.partial:
-                        # A psum somewhere downstream (grad all-reduce at
-                        # the apply boundary, activation psum at its
-                        # non-linear consumer). COST_FACTOR matches the
-                        # cost-planner's psum scaling.
-                        gs_coll += cost_factor * PerfUtils.all_reduce_cost(
-                            aval_bytes(ov.aval), gs.num_splits, self.spec)
-            if gs.reshard_edges:
-                # Rule-mode plans record their reshard decisions explicitly
-                # (FastSpmdStrategy Solution edges) — price those directly.
-                for nid, posmap in gs.reshard_edges.items():
-                    node = graph.nodes[nid]
-                    for pos, (src, want) in posmap.items():
-                        if src.partial:
-                            continue   # partial->psum priced above already
-                        a = node.invars[pos]
-                        gs_coll += transition_cost(
-                            src, want, aval_bytes(a.aval), gs.num_splits,
-                            self.spec)
-            else:
-                gs_coll += self._reshard_time(graph, gs)
-            coll_t += max(gs_coll, gs.comm_cost or 0.0)
+        coll_t = sum(
+            self.derived_comm(graph, gs, produced)
+            for gs, produced in zip(strategies, produced_maps))
 
         # Memory: parameters (sharded where split) + activation peak.
         from tepdist_tpu.parallel.sync_free import (

@@ -110,7 +110,8 @@ class TepdistClient:
     # -- execution ----------------------------------------------------
     def execute_plan(self, handle: int,
                      inline_args: Optional[Dict[int, Any]] = None,
-                     fetch_resource_variables: bool = False
+                     fetch_resource_variables: bool = False,
+                     inference: bool = False
                      ) -> Dict[str, Any]:
         blobs: List[bytes] = []
         inline, inline_meta = {}, {}
@@ -121,7 +122,8 @@ class TepdistClient:
             blobs.append(blob)
         resp = self.stub.call("ExecutePlan", protocol.pack(
             {"handle": handle, "inline": inline, "inline_meta": inline_meta,
-             "fetch_resource_variables": fetch_resource_variables}, blobs))
+             "fetch_resource_variables": fetch_resource_variables,
+             "inference": inference}, blobs))
         header, rblobs = protocol.unpack(resp)
         outputs = [protocol.decode_literal(m, rblobs[i])
                    for i, m in enumerate(header["outputs"])]

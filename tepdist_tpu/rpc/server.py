@@ -302,7 +302,10 @@ class TepdistServicer:
             with self._lock:
                 for oi, ii in plan.state_alias.items():
                     self.variables[ii] = outs[oi]
-            self.global_step += 1
+            if not header.get("inference"):
+                # Inference plans (generate) read weights without advancing
+                # the training step counter checkpoints are named by.
+                self.global_step += 1
         # Latched save?
         if self.ckpt_opts.get("save"):
             self._do_save(self.ckpt_opts.pop("save"))

@@ -191,9 +191,12 @@ def explore_parallelism(
                 spec = chip_spec()
                 comm = ring_comm_cost(motifs, s, spec, with_backward=True)
                 if d > 1:
-                    gs_d = plan_axes(graph, MeshTopology([("data", d)]),
-                                     None, "cost")[0]
-                    comm += gs_d.comm_cost or 0.0
+                    topo_d = MeshTopology([("data", d)])
+                    gs_d = plan_axes(graph, topo_d, None, "cost")[0]
+                    # Same re-derived pricing the Evaluator applies to the
+                    # rival SPMD candidates (comm_cost alone is a lower
+                    # bound that reported 0 for comm-dominated plans).
+                    comm += Evaluator(topo_d).derived_comm(graph, gs_d)
                 # Same COMM_OVERLAP discount the Evaluator applies to the
                 # rival SPMD candidates — hand-priced candidates must not
                 # compete with undiscounted serial comm in the same argmin.

@@ -431,3 +431,46 @@ def test_flash_attention_gpt2_over_rpc(server):
         l, p, o = local(p, o, tokens)
         ref.append(float(l))
     np.testing.assert_allclose(remote, ref, rtol=1e-4)
+
+
+def test_generate_from_trained_checkpoint(server):
+    """Sampling/inference through the service (reference: predict_fns.py —
+    decode runs on the server-held trained weights): train the test
+    config, checkpoint, restore, then greedy-decode over RPC and match
+    the local decode on the fetched weights exactly."""
+    port, _ = server
+    from tepdist_tpu.models import gpt2, sampling
+
+    cfg = gpt2.CONFIGS["test"]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg, 8, 32)
+    tx = optax.adam(1e-3)
+
+    def step(params, opt_state, tokens):
+        l, g = jax.value_and_grad(
+            lambda p: gpt2.loss_fn(p, tokens, cfg))(params)
+        u, opt_state = tx.update(g, opt_state, params)
+        return l, optax.apply_updates(params, u), opt_state
+
+    sess = TepdistSession(f"127.0.0.1:{port}", mesh_axes=[("data", 8)])
+    sess.compile_train_step(step, params, tx.init(params), tokens)
+    for _ in range(3):
+        sess.run(tokens)
+    sess.save()
+    sess.run(tokens)      # advance past the checkpoint...
+    sess.restore()        # ...and roll back to it
+
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 8), 0,
+                                cfg.vocab_size)
+
+    def gen_fn(p, prompt):
+        return sampling.sample(p, prompt, cfg, max_new_tokens=6,
+                               greedy=True)
+
+    sess.compile_generate(gen_fn, params, prompt)
+    remote = sess.generate(prompt)
+
+    local = sampling.sample(sess.params(), prompt, cfg, max_new_tokens=6,
+                            greedy=True)
+    np.testing.assert_array_equal(np.asarray(remote), np.asarray(local))
+    sess.close()
