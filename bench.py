@@ -576,6 +576,24 @@ def main() -> None:
         except Exception:
             extra.append({"metric": "runtime", "error":
                           traceback.format_exc(limit=3).splitlines()[-1]})
+        # Carry forward the last TPU round's secondary lines STALE-FLAGGED
+        # (mirroring the headline policy) instead of silently dropping
+        # them: the fresh runtime line replaces only its own metric.
+        fresh_metrics = {e.get("metric") for e in extra}
+        try:
+            prior = json.load(open(EXTRA_FILE)).get("extra", [])
+        except Exception:
+            prior = []
+        for line in prior:
+            if (line.get("metric") in fresh_metrics or "error" in line
+                    or "value" not in line):
+                continue
+            if not line.get("stale"):
+                line = dict(line)
+                line["stale"] = True
+                line["stale_reason"] = ("TPU backend unavailable this run; "
+                                        "carried from last TPU round")
+            extra.append(line)
         try:
             tmp = f"{EXTRA_FILE}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:

@@ -115,17 +115,22 @@ def sample(params, prompt, cfg: GPT2Config, *, max_new_tokens: int,
     L = T + max_new_tokens
     if L > cfg.n_ctx:
         raise ValueError(f"{L} tokens > n_ctx={cfg.n_ctx}")
-    if key is None:
-        key = jax.random.PRNGKey(0)
+    # Fetched/restored checkpoints hand back numpy leaves; numpy tables
+    # can't be indexed by traced token ids, so lift to jnp once here.
+    params = jax.tree_util.tree_map(jnp.asarray, params)
     cache = init_cache(cfg, B, L)
     logits, cache = _forward_with_cache(params, prompt, cache, 0, cfg)
     # The scan carry holds the RNG as RAW uint32 key data, not a typed
-    # key<fry> array — typed-key avals don't serialize, and the sampler
-    # must ship over RPC (greedy threads no RNG at all).
+    # key<fry> array, and greedy decoding touches no RNG API at all (the
+    # default key materialises only in the non-greedy branch) — so a
+    # greedy sampler jaxpr contains zero key-typed eqns and stochastic
+    # ones only serde-supported ones.
     if greedy:
         kd = jnp.zeros((0,), jnp.uint32)
         sub = None
     else:
+        if key is None:
+            key = jax.random.PRNGKey(0)
         if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
             key = jax.random.key_data(key)
         key, sub = _split_data(key)

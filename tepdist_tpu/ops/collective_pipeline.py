@@ -78,6 +78,7 @@ def collective_pipeline(
     mesh: Mesh,
     axis: str = "stage",
     data_axis: Optional[str] = None,
+    model_axis: Optional[str] = None,
     stage_param_spec: Optional[Any] = None,
 ) -> Callable:
     """Build ``pipelined(stacked_params, x_micro) -> y_micro``.
@@ -91,6 +92,15 @@ def collective_pipeline(
     micro-batch row dim (dim 1 of x_micro) shards over it, params replicate
     over it, and activations hop stage->stage WITHIN each data slice (the
     reference's nested stage x spmd ordinals, one program).
+
+    ``model_axis``: optional third mesh axis for PP x TP hybrid (the
+    reference's 3-ordinal stage x spmd nesting). The pipeline wavefront
+    stays MANUAL over ``axis``/``data_axis`` (ppermute hops) while
+    ``model_axis`` is left in AUTO mode: shard the stacked params over it
+    before the call (e.g. ``device_put`` with a ``P(axis, ..., model)``
+    NamedSharding) and GSPMD propagates the TP sharding through every
+    stage_fn application, inserting the intra-stage collectives — stages,
+    dp and tp compose in ONE jitted program.
     """
     S = mesh.shape[axis]
 
@@ -103,12 +113,18 @@ def collective_pipeline(
         param_specs = jax.tree_util.tree_map(
             lambda _: P(axis), stacked_params)
         x_spec = P(None, data_axis) if data_axis else P()
+        kw = {}
+        if model_axis is not None:
+            # Partial-manual shard_map: the model axis stays auto (GSPMD).
+            kw["axis_names"] = {axis} | (
+                {data_axis} if data_axis else set())
         inner = jax.shard_map(
             lambda p, x: local(
                 jax.tree_util.tree_map(lambda a: a[0], p), x),
             mesh=mesh,
             in_specs=(param_specs, x_spec),
             out_specs=x_spec,
+            **kw,
         )
         return inner(stacked_params, x_micro)
 

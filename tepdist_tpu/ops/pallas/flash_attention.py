@@ -252,7 +252,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def _default_block(T: int) -> int:
+def _default_block(T: int) -> Optional[int]:
     """Largest divisor of T up to 512. On-chip sweep (v5e, GPT-2 1.5B
     training step, T=1024/D=64): 512x512 tiles beat the conventional
     128x128 by 39% end to end (8,495 vs 6,138 tok/s) — bigger tiles mean
@@ -293,7 +293,7 @@ def flash_attention(q, k, v, causal: bool = True,
     B, H, T, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     default = _default_block(T)
-    if default is None and (block_q is None or block_k is None):
+    if default is None and block_q is None and block_k is None:
         if causal:
             # Pad T up to the next multiple of 128 and slice the result:
             # under the causal mask real queries (pos < T) never attend
@@ -309,8 +309,10 @@ def flash_attention(q, k, v, causal: bool = True,
         # Non-causal: padded keys would be attended; dense is the only
         # exact fallback (rare — awkward T with bidirectional attention).
         return _dense_attention(q, k, v, causal, scale)
-    block_q = min(block_q or default, T)
-    block_k = min(block_k or default, T)
+    # An explicitly-passed block wins even when no default exists; the
+    # missing one derives from its partner (divisibility still checked).
+    block_q = min(block_q or block_k or default, T)
+    block_k = min(block_k or block_q, T)
     if T % block_q or T % block_k:
         raise ValueError(f"seq len {T} must divide blocks {block_q}/{block_k}")
     if interpret is None:

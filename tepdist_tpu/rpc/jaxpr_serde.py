@@ -31,21 +31,38 @@ from jax._src import core as _core
 import logging
 log = logging.getLogger(__name__)
 
-# Prims whose equations can carry jax effects (Ref read/write inside pallas
-# kernels; state primitives; control flow that propagates inner effects).
-# Everything else is effect-free in serializable programs and keeps
-# no_effects without re-running abstract_eval on decode.
-_EFFECTFUL_PRIMS = frozenset({
-    "pallas_call", "scan", "while", "cond", "pjit", "closed_call",
-    "core_call", "custom_vjp_call", "custom_jvp_call", "shard_map",
-    "remat2", "checkpoint",
+# Prims that ARE effects at the leaf level (Ref read/write inside pallas
+# kernels; state primitives; host interaction). Call-like prims (scan/
+# while/cond/pjit/shard_map/remat/custom_* — under whatever name this jax
+# version uses) are handled STRUCTURALLY instead: their decoded sub-jaxpr
+# params carry recomputed effects, so an eqn re-runs abstract_eval only
+# when an inner effect actually exists — effect-free bodies (the RPC hot
+# path) decode without paying a recursive abstract_eval.
+_LEAF_EFFECT_PRIMS = frozenset({
     # state / pallas kernel-side primitives (the registry registers
     # jax._src.state.primitives and jax._src.pallas.primitives)
     "get", "swap", "addupdate", "masked_swap",
     "atomic_rmw", "atomic_cas", "run_scoped",
     "semaphore_signal", "semaphore_wait", "semaphore_read",
     "debug_print", "debug_callback",
+    # host-interaction prims: ordered effects by construction
+    "infeed", "outfeed", "io_callback", "pure_callback",
 })
+
+
+def _may_carry_effects(prim, params: dict) -> bool:
+    """Leaf-effect whitelist, plus the structural check: any eqn whose
+    decoded sub-jaxpr params carry effects must be re-abstract-eval'd so
+    the effects propagate to this eqn."""
+    if prim.name in _LEAF_EFFECT_PRIMS:
+        return True
+    for v in params.values():
+        for x in (v if isinstance(v, (tuple, list)) else (v,)):
+            if isinstance(x, _core.Jaxpr) and x.effects:
+                return True
+            if isinstance(x, jexcore.ClosedJaxpr) and x.jaxpr.effects:
+                return True
+    return False
 
 
 # --------------------------------------------------------------------------
@@ -200,7 +217,40 @@ def _dec_treedef(d: dict):
 # Value encoding
 # --------------------------------------------------------------------------
 
-def _enc_array(x: np.ndarray) -> dict:
+def _is_key_array(x) -> bool:
+    dt = getattr(x, "dtype", None)
+    return dt is not None and jax.dtypes.issubdtype(dt, jax.dtypes.extended)
+
+
+def _keyimpl_name(dtype) -> str:
+    """Extended-dtype support is PRNG keys only; anything else is a clear
+    error rather than a silent mis-encode."""
+    if jax.dtypes.issubdtype(dtype, jax.dtypes.prng_key):
+        return dtype._impl.name
+    raise TypeError(f"cannot serialize extended dtype {dtype!r} "
+                    "(only PRNG key dtypes are supported)")
+
+
+def _key_dtype(impl_name: str):
+    from jax._src import prng as _prng
+    return _prng.KeyTy(_prng.prngs[impl_name])
+
+
+def _enc_array(x) -> dict:
+    dt = getattr(x, "dtype", None)
+    if dt is not None and jax.dtypes.issubdtype(dt, jax.dtypes.extended):
+        # Typed PRNG keys (key<fry> etc.): the wire carries the raw uint32
+        # key data plus the impl name; the receiver rebuilds the typed array
+        # with jax.random.wrap_key_data. Reference analogue: opaque-typed
+        # HLO constants round-trip by value+type, hlo.proto:543-582.
+        name = _keyimpl_name(dt)
+        data = np.asarray(jax.random.key_data(x))
+        return {"t": "ndarray", "dtype": "key:" + name,
+                "shape": list(x.shape),
+                "data": base64.b64encode(
+                    np.ascontiguousarray(data).tobytes()).decode(),
+                "keydata_dtype": data.dtype.name,
+                "keydata_shape": list(data.shape)}
     x = np.asarray(x)
     if x.dtype == jax.dtypes.float0:
         # float0 (symbolic-zero cotangents for integer primals) has
@@ -215,9 +265,16 @@ def _enc_array(x: np.ndarray) -> dict:
     }
 
 
-def _dec_array(d: dict) -> np.ndarray:
+def _dec_array(d: dict):
     if d["dtype"] == "float0":
         return np.zeros(d["shape"], dtype=jax.dtypes.float0)
+    if d["dtype"].startswith("key:"):
+        buf = base64.b64decode(d["data"])
+        data = np.frombuffer(
+            buf, dtype=np.dtype(d["keydata_dtype"])).reshape(
+                d["keydata_shape"])
+        return jax.random.wrap_key_data(
+            jax.numpy.asarray(data), impl=d["dtype"][4:])
     buf = base64.b64decode(d["data"])
     return np.frombuffer(buf, dtype=np.dtype(d["dtype"])).reshape(d["shape"])
 
@@ -233,6 +290,11 @@ def encode_value(v: Any) -> Any:
         return {"t": "dtype", "v": v.name}
     if isinstance(v, type) and issubclass(v, np.generic):
         return {"t": "dtype", "v": np.dtype(v).name}
+    if type(v).__name__ == "PRNGImpl":
+        # random_seed/random_wrap carry the PRNG impl (a NamedTuple of
+        # functions) as a param; only the registry name crosses the wire —
+        # must run before the generic tuple branch.
+        return {"t": "prng_impl", "v": v.name}
     for name, cls in _NAMEDTUPLES.items():
         if isinstance(v, cls):
             return {"t": "namedtuple", "cls": name,
@@ -251,7 +313,7 @@ def encode_value(v: Any) -> Any:
                 "v": [[encode_value(k), encode_value(x)]
                       for k, x in v.items()]}
     if isinstance(v, (np.ndarray, jax.Array)):
-        return _enc_array(np.asarray(v))
+        return _enc_array(v)
     if isinstance(v, jexcore.ClosedJaxpr):
         return {"t": "closed_jaxpr", "v": _encode_closed(v)}
     if isinstance(v, _core.Jaxpr):
@@ -321,6 +383,9 @@ def decode_value(v: Any) -> Any:
     t = v["t"]
     if t == "dtype":
         return np.dtype(v["v"])
+    if t == "prng_impl":
+        from jax._src import prng as _prng
+        return _prng.prngs[v["v"]]
     if t == "ndarray":
         return _dec_array(v)
     if t == "namedtuple":
@@ -409,10 +474,17 @@ def _aval_dict(aval) -> dict:
         ms = aval.memory_space
         return {"ref": _aval_dict(aval.inner_aval),
                 "memory_space": None if ms is None else encode_value(ms)}
+    if jax.dtypes.issubdtype(aval.dtype, jax.dtypes.extended):
+        # PRNG-key avals (key<fry> etc.): encode the impl name; _make_aval
+        # rebuilds the KeyTy dtype from the live impl registry.
+        dt = "key:" + _keyimpl_name(aval.dtype)
+    elif aval.dtype == jax.dtypes.float0:
+        dt = "float0"
+    else:
+        dt = np.dtype(aval.dtype).name
     d = {
         "shape": list(aval.shape),
-        "dtype": (np.dtype(aval.dtype).name
-                  if aval.dtype != jax.dtypes.float0 else "float0"),
+        "dtype": dt,
         "weak_type": bool(getattr(aval, "weak_type", False)),
     }
     vma = getattr(aval, "vma", None)
@@ -442,7 +514,9 @@ def _make_aval(d: dict):
         kw["sharding"] = decode_value(d["sharding"])
     if d.get("vma"):
         kw["vma"] = frozenset(d["vma"])
-    return _core.ShapedArray(tuple(d["shape"]), np.dtype(d["dtype"]),
+    dtype = (_key_dtype(d["dtype"][4:]) if d["dtype"].startswith("key:")
+             else np.dtype(d["dtype"]))
+    return _core.ShapedArray(tuple(d["shape"]), dtype,
                              weak_type=d.get("weak_type", False), **kw)
 
 
@@ -456,7 +530,7 @@ def _encode_jaxpr(jaxpr) -> dict:
 
     def enc_atom(a):
         if isinstance(a, jexcore.Literal):
-            return {"k": "lit", "v": _enc_array(np.asarray(a.val)),
+            return {"k": "lit", "v": _enc_array(a.val),
                     "aval": _aval_dict(a.aval)}
         return {"k": "var", "id": vid(a), "aval": _aval_dict(a.aval)}
 
@@ -503,6 +577,10 @@ def _decode_jaxpr_struct(d: dict):
         if a["k"] == "lit":
             val = _dec_array(a["v"])
             aval = _make_aval(a["aval"])
+            if jax.dtypes.issubdtype(aval.dtype, jax.dtypes.extended):
+                # Typed-key literal: _dec_array already rebuilt the jax
+                # key array; np casting does not apply.
+                return jexcore.Literal(val, aval)
             if not aval.shape:
                 val = val.reshape(())
                 # scalars come back as 0-d arrays; Literal accepts those
@@ -545,7 +623,7 @@ def _decode_jaxpr_struct(d: dict):
         # genuine decode error in a plain prim can't hide behind a blanket
         # except here.
         effects = _core.no_effects
-        if prim.name in _EFFECTFUL_PRIMS:
+        if _may_carry_effects(prim, params):
             try:
                 out = prim.abstract_eval(*[x.aval for x in inv], **params)
                 if isinstance(out, tuple) and len(out) == 2:
@@ -561,14 +639,19 @@ def _decode_jaxpr_struct(d: dict):
         # Deserialized jaxprs have no source program to point DebugInfo at;
         # jax's default placeholder is exactly right here.
         warnings.simplefilter("ignore", DeprecationWarning)
+        # The jaxpr-level effects are the union of its eqns' (jax invariant)
+        # — required so _may_carry_effects sees nested effects through
+        # sub-jaxpr params instead of re-running abstract_eval everywhere.
+        effects = _core.join_effects(*[e.effects for e in eqns])
         return _core.Jaxpr(constvars=constvars, invars=invars,
-                           outvars=outvars, eqns=eqns)
+                           outvars=outvars, eqns=eqns, effects=effects)
 
 
 def _encode_closed(closed) -> dict:
     return {
         "jaxpr": _encode_jaxpr(closed.jaxpr),
-        "consts": [encode_value(np.asarray(c)) for c in closed.consts],
+        "consts": [encode_value(c if _is_key_array(c) else np.asarray(c))
+                   for c in closed.consts],
     }
 
 
@@ -599,7 +682,8 @@ def serialize_pytree_leaves(tree) -> Tuple[bytes, Any]:
     """Flatten a pytree of arrays -> (bytes, treedef) for literal transfer
     (reference: TransferToServerHost raw-bytes path)."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    payload = [encode_value(np.asarray(l)) for l in leaves]
+    payload = [encode_value(l if _is_key_array(l) else np.asarray(l))
+               for l in leaves]
     return json.dumps(payload).encode(), treedef
 
 

@@ -40,6 +40,13 @@ class PipelinePlanMaps:
     recv_tasks: Dict[Tuple[Tuple[int, int], int], int]
     merge_task: int = -1
     split_task: int = -1
+    # RECV id -> expected placement at the consumer, as ("in", stage, pos)
+    # for activations (consumer stage input position) or ("out", stage, k)
+    # for cotangents (cot of stage's output k). Lets the executor place
+    # received values by the consumer's PLANNED sharding under stage x TP
+    # nesting instead of a generic replicate rule.
+    recv_target: Dict[int, Tuple[str, int, int]] = dataclasses.field(
+        default_factory=dict)
 
 
 def build_pipeline_task_dag(
@@ -102,6 +109,7 @@ def build_pipeline_task_dag(
                         micro=m, device_group=stage_devices[s], out_bytes=b)
                     dag.add_edge(send, recv, out_idx=0, arg_pos=0)
                     maps.recv_tasks[key] = recv.id
+                    maps.recv_target[recv.id] = ("in", s, pos)
                 dag.add_edge(dag.node(maps.recv_tasks[key]), fwd,
                              out_idx=0, arg_pos=pos)
 
@@ -155,6 +163,7 @@ def build_pipeline_task_dag(
                             device_group=stage_devices[s], out_bytes=b)
                         dag.add_edge(send, recv, out_idx=0, arg_pos=0)
                         dag.add_edge(recv, bwd, out_idx=0, arg_pos=n_in + k)
+                        maps.recv_target[recv.id] = ("out", s, k)
                     else:
                         dag.add_edge(src_node, bwd, out_idx=src_out,
                                      arg_pos=n_in + k)

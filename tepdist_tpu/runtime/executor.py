@@ -49,18 +49,40 @@ class PipelineExecutable:
         devices: Optional[Sequence] = None,
         optimizer=None,
         intra_stage_dp: bool = True,
+        intra_stage_tp: int = 1,
+        stage_var_mem_limit: Optional[int] = None,
     ):
         """``intra_stage_dp``: shard the micro-batch dim over each stage's
         device subset (PP x DP hybrid — the reference's nested split
         ordinals, stage x spmd). Params stay replicated within a stage;
         per-micro gradients come out partial and GSPMD inserts the
-        intra-stage psum at the GA/apply boundary."""
+        intra-stage psum at the GA/apply boundary.
+
+        ``intra_stage_tp``: model-parallel degree WITHIN each stage (the
+        reference's stage x spmd nesting with a model ordinal,
+        auto_parallel.cc:132-181 + dev_id_util.h:94-192). Each stage gets a
+        2-D (intra, model) device grid; the cone/ILP planner runs on the
+        stage's forward jaxpr over the ``model`` axis, and the AOT stage
+        executables pin every input/output to the planned sharding so GSPMD
+        inserts the intra-stage TP collectives. Composes with
+        ``intra_stage_dp`` (stage x dp x tp).
+
+        ``stage_var_mem_limit``: per-device byte budget for each stage's
+        variables, enforced inside the stage planner's ILP (reference:
+        SplitPlanByMemCost / VAR_MEM_LIMIT) — weight TP emerges where
+        replication would not fit. Defaults to the VAR_MEM_LIMIT env."""
         self.prog = prog
         S = prog.num_stages
         devices = list(devices if devices is not None else jax.devices())
         if len(devices) < S:
             raise ValueError(f"need >= {S} devices for {S} stages")
         per = len(devices) // S
+        tp = max(int(intra_stage_tp), 1)
+        if per % tp:
+            raise ValueError(
+                f"{per} devices/stage not divisible by intra_stage_tp={tp}")
+        self.tp = tp
+        dp = per // tp
         self.stage_devices: List[Tuple[int, ...]] = []
         self.stage_meshes: List[Mesh] = []
         self.stage_shardings: List[NamedSharding] = []   # replicated
@@ -69,18 +91,32 @@ class PipelineExecutable:
         if prog.batch_flat_indices:
             b0 = prog.graph.invars[prog.batch_flat_indices[0]]
             micro_rows = b0.aval.shape[prog.batch_dim]
-        self.intra_dp = (intra_stage_dp and per > 1 and micro_rows is not None
-                         and micro_rows % per == 0)
+        self._micro_rows = micro_rows
+        self.intra_dp = (intra_stage_dp and dp > 1 and micro_rows is not None
+                         and micro_rows % dp == 0)
         for s in range(S):
             devs = devices[s * per:(s + 1) * per]
             self.stage_devices.append(tuple(d.id for d in devs))
-            mesh = Mesh(np.array(devs), axis_names=("intra",))
+            if tp > 1:
+                mesh = Mesh(np.array(devs).reshape(dp, tp),
+                            axis_names=("intra", "model"))
+            else:
+                mesh = Mesh(np.array(devs), axis_names=("intra",))
             self.stage_meshes.append(mesh)
             self.stage_shardings.append(NamedSharding(mesh, PartitionSpec()))
             self.stage_batch_shardings.append(
                 NamedSharding(mesh, PartitionSpec("intra"))
                 if self.intra_dp else
                 NamedSharding(mesh, PartitionSpec()))
+        # Per-stage TP plans: pos -> PartitionSpec / out k -> PartitionSpec.
+        self._tp_in_specs: List[Optional[List[PartitionSpec]]] = [None] * S
+        self._tp_out_specs: List[Optional[List[PartitionSpec]]] = [None] * S
+        if stage_var_mem_limit is None:
+            env_lim = ServiceEnv.get().var_mem_limit
+            stage_var_mem_limit = env_lim if env_lim > 0 else None
+        self._stage_var_mem_limit = stage_var_mem_limit
+        if tp > 1:
+            self._plan_stage_tp()
 
         self.dag, self.maps = build_pipeline_task_dag(
             prog, self.stage_devices)
@@ -118,6 +154,75 @@ class PipelineExecutable:
         self._apply_jit: Dict[int, Callable] = {}
 
     # ------------------------------------------------------------------
+    def _compose_spec(self, aval, st, allow_intra: bool) -> PartitionSpec:
+        """Compose the intra-DP batch rule with the planner's model-axis
+        choice into one PartitionSpec (stage x dp x tp nesting)."""
+        nd = getattr(aval, "ndim", 0)
+        parts: List[Any] = [None] * nd
+        if (allow_intra and self.intra_dp and nd >= 1 and self._micro_rows
+                and aval.shape[0] == self._micro_rows):
+            parts[0] = "intra"
+        if (st is not None and st.is_split() and st.partition_dim < nd
+                and parts[st.partition_dim] is None
+                and aval.shape[st.partition_dim] % self.tp == 0):
+            parts[st.partition_dim] = "model"
+        while parts and parts[-1] is None:
+            parts.pop()
+        return PartitionSpec(*parts)
+
+    def _plan_stage_tp(self) -> None:
+        """Run the cost planner on each stage's forward jaxpr over the
+        ``model`` axis (reference: per-stage SPMD planning under the stage
+        split ordinal — CostSpmdStrategy applied inside each DefContext).
+        Fills ``_tp_in_specs``/``_tp_out_specs`` (PartitionSpecs per stage
+        input position / output index)."""
+        from tepdist_tpu.graph.jaxpr_graph import trace_graph
+        from tepdist_tpu.parallel.cost_spmd_strategy import CostSpmdStrategy
+
+        prog, tp = self.prog, self.tp
+        fwd_fns = prog.decomp.forward_fns()
+        batch_set = set(prog.batch_flat_indices)
+        for s in range(prog.num_stages):
+            mod = prog.stages[s]
+            sds = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
+                   for v in mod.invars]
+            g, _, _ = trace_graph(fwd_fns[s], *sds)
+            # The intra axis owns the micro-batch dim: the model planner
+            # may not re-split dim 0 of ANY micro-row tensor (invars AND
+            # interior activations — the batch dim flows through).
+            forbidden: Dict[Any, set] = {}
+            if self.intra_dp and self._micro_rows:
+                from jax.extend import core as jexcore
+                allv = list(g.invars)
+                for n in g.nodes:
+                    allv.extend(ov for ov in n.eqn.outvars
+                                if isinstance(ov, jexcore.Var))
+                for v in allv:
+                    shape = getattr(v.aval, "shape", ())
+                    if shape and shape[0] == self._micro_rows:
+                        forbidden[v] = {0}
+            gs = CostSpmdStrategy(
+                g, "model", tp, fixed={}, forbidden_dims=forbidden,
+                mem_limit_bytes=self._stage_var_mem_limit).run()
+            in_specs, out_specs = [], []
+            for pos, v in enumerate(g.invars):
+                src = mod.input_def_map[pos]
+                allow_intra = (src[0] == "stage"
+                               or (src[0] == "arg" and src[1] in batch_set))
+                in_specs.append(self._compose_spec(
+                    mod.invars[pos].aval, gs.var_strategies.get(v),
+                    allow_intra))
+            for k in range(len(mod.outvars)):
+                st = (gs.out_strategies[k]
+                      if k < len(gs.out_strategies) else None)
+                out_specs.append(self._compose_spec(
+                    mod.outvars[k].aval, st, True))
+            self._tp_in_specs[s] = in_specs
+            self._tp_out_specs[s] = out_specs
+            log.info("stage %d TP plan over model=%d: %d/%d inputs split",
+                     s, tp, sum(1 for p in in_specs if "model" in tuple(p)),
+                     len(in_specs))
+
     def _stage_sharding_for(self, s: int, aval) -> NamedSharding:
         """The placement rule every producer/consumer agrees on: micro-batch
         tensors (leading dim == micro rows) shard over the intra axis under
@@ -131,13 +236,25 @@ class PipelineExecutable:
         return self.stage_shardings[s]
 
     def _pos_sharding(self, s: int, mod, pos: int) -> NamedSharding:
-        """Placement of stage input ``pos``: params replicate, batch args
-        and interior activations follow the micro-rows rule."""
+        """Placement of stage input ``pos``: under TP, the stage planner's
+        spec; otherwise params replicate, batch args and interior
+        activations follow the micro-rows rule."""
+        if self._tp_in_specs[s] is not None:
+            return NamedSharding(self.stage_meshes[s],
+                                 self._tp_in_specs[s][pos])
         src = mod.input_def_map[pos]
         if src[0] == "arg" and src[1] not in set(
                 self.prog.batch_flat_indices):
             return self.stage_shardings[s]
         return self._stage_sharding_for(s, mod.invars[pos].aval)
+
+    def _out_sharding(self, s: int, k: int) -> NamedSharding:
+        """Placement of stage ``s`` output ``k``."""
+        if self._tp_out_specs[s] is not None:
+            return NamedSharding(self.stage_meshes[s],
+                                 self._tp_out_specs[s][k])
+        return self._stage_sharding_for(
+            s, self.prog.stages[s].outvars[k].aval)
 
     def _aot(self, fn: Callable, s: int, in_avals, in_shs, out_avals,
              out_shs, donate: Tuple[int, ...] = ()) -> Callable:
@@ -179,6 +296,14 @@ class PipelineExecutable:
                   for p in self._stage_ppos[s])
             for s in range(S)
         ]
+
+        # Param placement by (stage, graph invar idx) — under TP this is
+        # the planner's spec, not plain replication.
+        self._param_sharding: Dict[Tuple[int, int], NamedSharding] = {}
+        for s in range(S):
+            mod = prog.stages[s]
+            for p, i in zip(self._stage_ppos[s], self._stage_pidx[s]):
+                self._param_sharding[(s, i)] = self._pos_sharding(s, mod, p)
 
         # Which cot positions are wired per stage (from the DAG build):
         for s in range(S):
@@ -235,8 +360,8 @@ class PipelineExecutable:
             in_shs = [self._pos_sharding(s, mod, p)
                       for p in range(len(in_avals))]
             fwd_out_avals = tuple(v.aval for v in mod.outvars)
-            fwd_out_shs = tuple(self._stage_sharding_for(s, a)
-                                for a in fwd_out_avals)
+            fwd_out_shs = tuple(self._out_sharding(s, k)
+                                for k in range(len(mod.outvars)))
             self._fwd_jit.append(self._aot(
                 fwd, s, in_avals, in_shs, fwd_out_avals, fwd_out_shs))
 
@@ -244,8 +369,7 @@ class PipelineExecutable:
             # cotangents for interior activations) — all placed by the same
             # rule the consumers (GA / SEND / cross-stage RECV) assume.
             bwd_in_avals = in_avals + [mod.outvars[k].aval for k in wired]
-            bwd_in_shs = in_shs + [self._stage_sharding_for(
-                s, mod.outvars[k].aval) for k in wired]
+            bwd_in_shs = in_shs + [self._out_sharding(s, k) for k in wired]
             bwd_out_avals = tuple(in_avals)
             bwd_out_shs = tuple(in_shs)
             self._bwd_jit.append(self._aot(
@@ -254,7 +378,7 @@ class PipelineExecutable:
 
             ppos = self._stage_ppos[s]
             param_avals = tuple(mod.invars[p].aval for p in ppos)
-            param_shs = tuple(self.stage_shardings[s] for _ in ppos)
+            param_shs = tuple(self._pos_sharding(s, mod, p) for p in ppos)
             # GA flattens (acc tuple, bwd_outs tuple) positionally; the
             # accumulator is donated — only its chain consumes it.
             n_acc = len(param_avals)
@@ -295,7 +419,9 @@ class PipelineExecutable:
             if s is None:
                 # Unused param: keep on stage 0.
                 s = 0
-            self.var_store[i] = jax.device_put(leaf, self.stage_shardings[s])
+            self.var_store[i] = jax.device_put(
+                leaf, self._param_sharding.get((s, i),
+                                               self.stage_shardings[s]))
         if self.optimizer is not None:
             for s in range(self.prog.num_stages):
                 sub = {i: self.var_store[i]
@@ -313,7 +439,9 @@ class PipelineExecutable:
             cached = self._param_cache.get(key)
             if cached is not None and cached[0] is val:
                 return cached[1]
-            put = jax.device_put(val, self.stage_shardings[s])
+            put = jax.device_put(
+                val, self._param_sharding.get((s, i),
+                                              self.stage_shardings[s]))
             self._param_cache[key] = (val, put)
             return put
         return val
@@ -377,7 +505,14 @@ class PipelineExecutable:
                 if src[0] == "arg":
                     i = src[1]
                     if i in batch_set:
-                        val = self._put_stage(s, micro_slices[(m, i)])
+                        if self._tp_in_specs[s] is not None:
+                            # The stage planner may shard batch args over
+                            # the model axis too (e.g. sequence dim).
+                            val = jax.device_put(
+                                micro_slices[(m, i)],
+                                self._pos_sharding(s, mod, pos))
+                        else:
+                            val = self._put_stage(s, micro_slices[(m, i)])
                     else:
                         val = self._stage_param(s, i)
                     args.append(val)
@@ -406,13 +541,32 @@ class PipelineExecutable:
                 cot_args = [outputs[pid][oi] for pos, (pid, oi) in
                             sorted(node.input_specs.items())
                             if pos >= n_in]
+                if self.tp > 1:
+                    # Same-device-group cots arrive with the PRODUCER's
+                    # sharding; the AOT bwd is pinned to this stage's out
+                    # specs (device_put is a no-op when they already match).
+                    ks = [pos - n_in for pos in
+                          sorted(node.input_specs) if pos >= n_in]
+                    cot_args = [jax.device_put(c, self._out_sharding(s, k))
+                                for c, k in zip(cot_args, ks)]
                 outputs[tid] = self._bwd_jit[s](*args, *cot_args)
             elif tt == TaskType.SEND:
                 pid, oi = node.input_specs[0]
                 outputs[tid] = (outputs[pid][oi],)
             elif tt == TaskType.RECV:
                 pid, oi = node.input_specs[0]
-                val = self._put_stage(s, outputs[pid][oi])
+                val = outputs[pid][oi]
+                target = self.maps.recv_target.get(tid)
+                if target is not None:
+                    # Place by the consumer's PLANNED sharding (stage x TP:
+                    # the generic replicate rule would gather TP-split
+                    # activations on every hop).
+                    kind, ts_, ix = target
+                    sh = (self._pos_sharding(ts_, self.prog.stages[ts_], ix)
+                          if kind == "in" else self._out_sharding(ts_, ix))
+                    val = jax.device_put(val, sh)
+                else:
+                    val = self._put_stage(s, val)
                 outputs[tid] = (val,)
             elif tt == TaskType.GAINIT:
                 outputs[tid] = (self._gainit[s](),)
@@ -486,11 +640,21 @@ class PipelineExecutable:
 
         owned = [i for i in self._stage_pidx[s] if self.param_owner[i] == s]
         params = {i: self.var_store[i] for i in owned}
-        # Cross-stage accumulators must land on this stage's devices before
-        # they can join the jitted update.
-        eaccs = [tuple(jax.device_put(g, self.stage_shardings[s])
-                       for g in extras[t]) for t in contrib] if contrib else []
+        # Cross-stage accumulators must land on this stage's devices (under
+        # TP: on the owner's PLANNED sharding for that param) before they
+        # can join the jitted update.
+        eaccs = [tuple(jax.device_put(
+                     g, self._param_sharding.get((s, i),
+                                                 self.stage_shardings[s]))
+                       for i, g in zip(self._stage_pidx[t], extras[t]))
+                 for t in contrib] if contrib else []
         new_params, self.opt_states[s] = self._apply_jit[key](
             params, self.opt_states[s], acc, *eaccs)
         for i in owned:
-            self.var_store[i] = new_params[i]
+            val = new_params[i]
+            sh = self._param_sharding.get((s, i))
+            if sh is not None and getattr(val, "sharding", None) != sh:
+                # The apply jit is not AOT-pinned; re-place so next step's
+                # AOT stage executables see the exact planned sharding.
+                val = jax.device_put(val, sh)
+            self.var_store[i] = val

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Any, Callable, Dict, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -223,21 +223,52 @@ def explore_parallelism(
     for S in (2, 4, 8):
         if S > n_devices or n_devices % S:
             continue
+        per = n_devices // S
         for M in {num_micro_batches, 2 * num_micro_batches}:
             if batch_rows % M:
                 continue
             try:
                 prog = plan_pipeline(loss_fn, S, M, params, *example_batch)
-                per = n_devices // S
-                stage_devs = [tuple(range(s * per, (s + 1) * per))
-                              for s in range(S)]
-                dag, _ = build_pipeline_task_dag(prog, stage_devs)
-                cost = Evaluator(
-                    MeshTopology([("stage", S)])).run_pipeline(dag)
-                candidates.append({"kind": "pipeline", "num_stages": S,
-                                   "num_micro_batches": M, "cost": cost})
             except Exception as e:  # noqa: BLE001
                 log.info("pipeline proposal S=%d M=%d failed: %s", S, M, e)
+                continue
+            stage_devs = [tuple(range(s * per, (s + 1) * per))
+                          for s in range(S)]
+            # Stage x spmd nesting (reference: up to 3 split ordinals incl.
+            # the stage level, auto_parallel.cc:132-181): each tp variant
+            # re-prices the SAME stage cut with per-stage compute divided
+            # over the model axis plus the stage planner's TP comm, folded
+            # into the task-time model as equivalent flops.
+            stage_graphs = None
+            for tp in (1, 2, 4, 8):
+                if tp > per or per % tp:
+                    continue
+                try:
+                    dag, _ = build_pipeline_task_dag(prog, stage_devs)
+                    if tp > 1:
+                        if stage_graphs is None:
+                            stage_graphs = _stage_fwd_graphs(prog)
+                        comm_s = _stage_tp_comm_seconds(stage_graphs, tp)
+                        from tepdist_tpu.parallel.performance_utils import (
+                            PerfUtils,
+                            chip_spec,
+                        )
+                        from tepdist_tpu.runtime.task_graph import TaskType
+                        sec_per_flop = PerfUtils.compute_time(
+                            1.0, chip_spec())
+                        for n in dag.nodes:
+                            if n.task_type == TaskType.COMPUTE:
+                                n.flops = (n.flops / tp
+                                           + comm_s[n.stage] / sec_per_flop)
+                    cost = Evaluator(
+                        MeshTopology([("stage", S)])).run_pipeline(dag)
+                    candidates.append(
+                        {"kind": "pipeline", "num_stages": S,
+                         "num_micro_batches": M, "intra_tp": tp,
+                         "cost": cost})
+                except Exception as e:  # noqa: BLE001
+                    log.info("pipeline proposal S=%d M=%d tp=%d failed: %s",
+                             S, M, tp, e)
     if not candidates:
         raise RuntimeError("no feasible parallelism proposal")
     best = min(candidates, key=lambda c: c["cost"].key())
@@ -247,6 +278,33 @@ def explore_parallelism(
         _dump_candidate_table(candidates, best)
     best["candidates"] = candidates
     return best
+
+
+def _stage_fwd_graphs(prog) -> List[Any]:
+    """Trace each stage's forward jaxpr ONCE (tp-independent; reused
+    across the tp variants of a proposal)."""
+    from tepdist_tpu.graph.jaxpr_graph import trace_graph
+
+    fwd_fns = prog.decomp.forward_fns()
+    graphs = []
+    for s in range(prog.num_stages):
+        mod = prog.stages[s]
+        sds = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
+               for v in mod.invars]
+        graphs.append(trace_graph(fwd_fns[s], *sds)[0])
+    return graphs
+
+
+def _stage_tp_comm_seconds(stage_graphs, tp: int) -> List[float]:
+    """Per-stage FORWARD TP comm time (seconds) under a ``model`` axis of
+    size ``tp``: the stage planner's comm-only objective. NOT doubled for
+    the backward — the caller adds it to both the fwd and the bwd COMPUTE
+    node of each (stage, micro), which prices the reverse collectives
+    (that mirror the forward's) exactly once."""
+    from tepdist_tpu.parallel.cost_spmd_strategy import CostSpmdStrategy
+
+    return [(CostSpmdStrategy(g, "model", tp, fixed={}).run().comm_cost
+             or 0.0) for g in stage_graphs]
 
 
 def _dump_candidate_table(candidates, best) -> None:
@@ -259,7 +317,9 @@ def _dump_candidate_table(candidates, best) -> None:
              f"{'duration_s':>12} {'coll%':>6} {'bubble%':>8}"]
     for r, c in enumerate(ranked):
         cfg = (str(c["topology"]) if c["kind"] == "spmd" else
-               f"S={c['num_stages']} M={c['num_micro_batches']}")
+               f"S={c['num_stages']} M={c['num_micro_batches']}"
+               + (f" tp={c['intra_tp']}" if c.get("intra_tp", 1) > 1
+                  else ""))
         cost = c["cost"]
         mark = " <== winner" if c is best else ""
         lines.append(f"{r:>4} {c['kind']:>8} {cfg:<28} "
@@ -277,6 +337,7 @@ def plan_training(
     topology: Optional[MeshTopology] = None,
     num_stages: Optional[int] = None,
     num_micro_batches: Optional[int] = None,
+    intra_stage_tp: Optional[int] = None,
     devices: Optional[Sequence] = None,
     mode: Optional[str] = None,
     annotations: Optional[dict] = None,
@@ -308,6 +369,8 @@ def plan_training(
         if best["kind"] == "pipeline":
             num_stages = best["num_stages"]
             num_micro_batches = best["num_micro_batches"]
+            if intra_stage_tp is None:
+                intra_stage_tp = best.get("intra_tp", 1)
         else:
             topology = best["topology"]
     if num_stages is None:
@@ -377,7 +440,14 @@ def plan_training(
         M = num_micro_batches or (
             env.num_micro_batches if env.num_micro_batches > 0 else 2)
         prog = plan_pipeline(loss_fn, num_stages, M, params, *example_batch)
-        exe = PipelineExecutable(prog, devices=devices, optimizer=optimizer)
+        # Stage x TP nesting: explicit arg, the exploration winner, or a
+        # 'model' axis on a caller-provided topology.
+        tp = intra_stage_tp
+        if tp is None and topology is not None:
+            tp = dict(topology.device_axes()).get("model", 1)
+        exe = PipelineExecutable(prog, devices=devices, optimizer=optimizer,
+                                 intra_stage_tp=tp or 1,
+                                 stage_var_mem_limit=var_mem_limit)
         return _PipelineTrainingPlan(exe, params)
 
     # ---- SPMD (+ GA) path ---------------------------------------------

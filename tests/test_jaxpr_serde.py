@@ -384,3 +384,59 @@ def test_ulysses_flash_inner_round_trip(devices):
         for x, y in zip(a, b):
             np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                        rtol=tol, atol=1e-6)
+
+
+def test_prng_key_round_trip():
+    """Typed-key (key<fry>) avals cross the wire: seed/wrap/unwrap/split/
+    fold_in/categorical eqns, a typed-key scan carry, and a typed-key
+    const/leaf all round-trip (VERDICT r3 ask #1)."""
+    def f(x):
+        k = jax.random.PRNGKey(0)           # random_seed + random_unwrap
+        k2 = jax.random.fold_in(jax.random.wrap_key_data(k), 7)
+        toks = jax.random.categorical(k2, x, axis=-1)
+        u = jax.random.uniform(jax.random.split(k2)[0], x.shape[:1])
+        return toks.astype(jnp.int32), u
+
+    x = jnp.linspace(-1.0, 1.0, 10).reshape(2, 5)
+    _round_trip_eval(f, x)
+
+
+def test_prng_key_scan_carry_round_trip():
+    """scan whose carry is a TYPED key array (not raw uint32)."""
+    def f(x):
+        def body(k, _):
+            k, sub = jax.random.split(k)
+            return k, jax.random.normal(sub, x.shape)
+        _, ys = jax.lax.scan(body, jax.random.key(0), None, length=3)
+        return ys.sum(0) + x
+
+    _round_trip_eval(f, jnp.ones((4,)))
+
+
+def test_prng_key_leaf_transfer():
+    """A typed key array as a pytree leaf (e.g. sampler extra arg)."""
+    k = jax.random.key(123)
+    data, treedef = serialize_pytree_leaves({"k": k, "x": jnp.arange(3)})
+    leaves = deserialize_leaves(data)
+    tree = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert jnp.issubdtype(tree["k"].dtype, jax.dtypes.prng_key)
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(tree["k"])),
+        np.asarray(jax.random.key_data(k)))
+    np.testing.assert_array_equal(np.asarray(tree["x"]), np.arange(3))
+
+
+def test_sampler_stochastic_round_trip():
+    """The round-3 flagship path: scan-over-decode with
+    jax.random.categorical ships over the wire and reproduces tokens."""
+    from tepdist_tpu.models import gpt2, sampling
+
+    cfg = gpt2.CONFIGS["test"]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.array([[1, 2, 3]], dtype=jnp.int32)
+
+    def gen(p, t):
+        return sampling.sample(p, t, cfg, max_new_tokens=4,
+                               temperature=0.8, top_k=5, greedy=False)
+
+    _round_trip_eval(gen, params, prompt)

