@@ -161,6 +161,10 @@ def _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret):
         q_block=block_q, seq_len=T)
     o, lse = pl.pallas_call(
         kernel,
+        # The name tags the eqn so the seq-axis planner can motif-match
+        # flash call sites in traced graphs (parallel/attention_motif.py)
+        # — causal flag and softmax scale ride along for the rewrite.
+        name=f"tepdist_flash_fwd__c{int(causal)}__s{scale!r}",
         grid=(B * H, T // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
@@ -180,7 +184,8 @@ def _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret):
     return o.reshape(B, H, T, D), lse.reshape(B, H, T)
 
 
-def _bwd_call(causal, scale, block_q, block_k, interpret, res, do):
+def _bwd_call(causal, scale, block_q, block_k, interpret, res, do,
+              dlse=None):
     q, k, v, o, lse = res
     B, H, T, D = q.shape
     BH = B * H
@@ -188,8 +193,12 @@ def _bwd_call(causal, scale, block_q, block_k, interpret, res, do):
     dof = do.reshape(BH, T, D)
     lsef = lse.reshape(BH, T, 1)
     # delta = rowsum(dO * O): cheap elementwise reduce, XLA fuses it.
+    # An LSE cotangent folds in exactly here: dS = P * (dP - delta + dLSE)
+    # (d lse / d s = P), so delta -= dlse reuses the unmodified kernels.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1).reshape(BH, T, 1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32).reshape(BH, T, 1)
 
     full_spec = pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0))
     row_full = pl.BlockSpec((1, T, 1), lambda b, i: (b, 0, 0))
@@ -252,6 +261,79 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_o_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    """(o, lse) flash: the LSE is a first-class differentiable output —
+    the per-block form ring attention merges across hops."""
+    return _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_o_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_o_lse_bwd(causal, scale, block_q, block_k, interpret, res, cts):
+    do, dlse = cts
+    return _bwd_call(causal, scale, block_q, block_k, interpret, res, do,
+                     dlse=dlse)
+
+
+_flash_o_lse.defvjp(_flash_o_lse_fwd, _flash_o_lse_bwd)
+
+
+def _resolve_blocks(T: int, block_q: Optional[int],
+                    block_k: Optional[int]) -> Optional[tuple]:
+    """Shared block dispatch: (block_q, block_k), or None when no
+    lane-aligned tile exists and the caller passed none (take a
+    fallback). An explicitly-passed block wins even when no default
+    exists; the missing one derives from its partner."""
+    default = _default_block(T)
+    if default is None and block_q is None and block_k is None:
+        return None
+    bq = min(block_q or block_k or default, T)
+    bk = min(block_k or bq, T)
+    if T % bq or T % bk:
+        raise ValueError(f"seq len {T} must divide blocks {bq}/{bk}")
+    return bq, bk
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             scale: Optional[float] = None,
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None,
+                             interpret: Optional[bool] = None):
+    """[B, H, T, D] -> (o [B, H, T, D], lse [B, H, T]), both
+    differentiable (the lse cotangent folds into the bwd delta). Used as
+    the per-hop inner of ring attention. Tile-less seq lens take the same
+    fallbacks as ``flash_attention``: causal pads to the next 128 multiple
+    (padded keys are masked, padded rows sliced — memory stays
+    O(T*block)); only non-causal awkward T goes dense."""
+    B, H, T, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    blocks = _resolve_blocks(T, block_q, block_k)
+    if blocks is None:
+        if causal:
+            Tp = -(-T // 128) * 128
+            pad = ((0, 0), (0, 0), (0, Tp - T), (0, 0))
+            o, lse = flash_attention_with_lse(
+                jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+                causal=True, scale=scale, interpret=interpret)
+            return o[:, :, :T, :], lse[:, :, :T]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        m = s.max(axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = p.sum(axis=-1, keepdims=True)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p / jnp.maximum(l, 1e-30),
+                       v.astype(jnp.float32))
+        return o.astype(q.dtype), (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
+    block_q, block_k = blocks
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    return _flash_o_lse(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
 def _default_block(T: int) -> Optional[int]:
     """Largest divisor of T up to 512. On-chip sweep (v5e, GPT-2 1.5B
     training step, T=1024/D=64): 512x512 tiles beat the conventional
@@ -292,8 +374,8 @@ def flash_attention(q, k, v, causal: bool = True,
     """q, k, v: [B, H, T, D] -> [B, H, T, D]. Differentiable (custom VJP)."""
     B, H, T, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
-    default = _default_block(T)
-    if default is None and block_q is None and block_k is None:
+    blocks = _resolve_blocks(T, block_q, block_k)
+    if blocks is None:
         if causal:
             # Pad T up to the next multiple of 128 and slice the result:
             # under the causal mask real queries (pos < T) never attend
@@ -309,12 +391,7 @@ def flash_attention(q, k, v, causal: bool = True,
         # Non-causal: padded keys would be attended; dense is the only
         # exact fallback (rare — awkward T with bidirectional attention).
         return _dense_attention(q, k, v, causal, scale)
-    # An explicitly-passed block wins even when no default exists; the
-    # missing one derives from its partner (divisibility still checked).
-    block_q = min(block_q or block_k or default, T)
-    block_k = min(block_k or block_q, T)
-    if T % block_q or T % block_k:
-        raise ValueError(f"seq len {T} must divide blocks {block_q}/{block_k}")
+    block_q, block_k = blocks
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     return _flash(q, k, v, causal, scale, block_q, block_k, interpret)

@@ -81,11 +81,98 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     return out.astype(q.dtype)
 
 
+def _ring_flash_local(q, k, v, *, axis_name: str, causal: bool,
+                      scale: Optional[float], return_lse: bool = False):
+    """Per-device body with the PALLAS FLASH KERNEL as the per-hop inner
+    (VERDICT r3 ask #5): each hop computes a blockwise (o, lse) pair via
+    flash_attention_with_lse and merges across hops by log-sum-exp — so
+    the memory-efficient kernel and the sequence axis compose instead of
+    being mutually exclusive. Causal block selection is positional: the
+    diagonal hop runs the causal kernel, strictly-lower hops the full
+    kernel, upper hops contribute -inf LSE (zero weight)."""
+    from tepdist_tpu.ops.pallas.flash_attention import (
+        flash_attention_with_lse,
+    )
+
+    P_ = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Tl, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    # No vma pcast here: the flash ring runs under check_vma=False (pallas
+    # out_shapes carry no vma — same posture as ops/ulysses.py).
+    m0 = jnp.full((B, H, Tl, 1), _NEG_INF, jnp.float32)
+    num0 = jnp.zeros((B, H, Tl, D), jnp.float32)
+    den0 = jnp.zeros((B, H, Tl, 1), jnp.float32)
+    perm = [(i, (i + 1) % P_) for i in range(P_)]
+
+    def hop(j, k_cur, v_cur):
+        def diag(_):
+            return flash_attention_with_lse(
+                q, k_cur, v_cur, causal=True, scale=scale)
+
+        def full(_):
+            return flash_attention_with_lse(
+                q, k_cur, v_cur, causal=False, scale=scale)
+
+        def skip(_):
+            return (jnp.zeros((B, H, Tl, D), q.dtype),
+                    jnp.full((B, H, Tl), _NEG_INF, jnp.float32))
+
+        if not causal:
+            return full(None)
+        return lax.cond(
+            j == idx, diag,
+            lambda op: lax.cond(j < idx, full, skip, op), None)
+
+    def body(s, carry):
+        k_cur, v_cur, m, num, den = carry
+        j = (idx - s) % P_          # owner of the resident K/V block
+        o_blk, lse_blk = hop(j, k_cur, v_cur)
+        lse_blk = lse_blk[..., None]
+        m_new = jnp.maximum(m, lse_blk)
+        w_old = jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(m - m_new))
+        w_new = jnp.where(lse_blk <= _NEG_INF / 2, 0.0,
+                          jnp.exp(lse_blk - m_new))
+        num = num * w_old + o_blk.astype(jnp.float32) * w_new
+        den = den * w_old + w_new
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, num, den)
+
+    _, _, m, num, den = lax.fori_loop(0, P_, body, (k, v, m0, num0, den0))
+    out = (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
+    if return_lse:
+        # Global LSE of the whole (ring-assembled) row: m + log(den).
+        return out, (m + jnp.log(jnp.maximum(den, 1e-30)))[..., 0]
+    return out
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
-                   causal: bool = True, scale: Optional[float] = None):
+                   causal: bool = True, scale: Optional[float] = None,
+                   inner: str = "einsum", return_lse: bool = False):
     """Sequence-parallel attention: [B, H, T, D] with T sharded over
-    ``axis_name`` of ``mesh``. Returns output with the same sharding."""
+    ``axis_name`` of ``mesh``. Returns output with the same sharding.
+
+    ``inner``: per-hop block compute — "einsum" (online-softmax einsum
+    blocks) or "flash" (the pallas flash kernel with LSE merging; the
+    long-context training composition). ``return_lse`` (flash inner only)
+    additionally returns the global [B, H, T] log-sum-exp."""
     spec = P(None, None, axis_name, None)
+    if inner == "flash":
+        fn = functools.partial(_ring_flash_local, axis_name=axis_name,
+                               causal=causal, scale=scale,
+                               return_lse=return_lse)
+        return jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=(spec, P(None, None, axis_name)) if return_lse
+            else spec,
+            # Pallas out_shapes carry no vma typing (ops/ulysses.py).
+            check_vma=False,
+        )(q, k, v)
+    if return_lse:
+        raise ValueError("return_lse requires inner='flash'")
     fn = functools.partial(_ring_attention_local, axis_name=axis_name,
                            causal=causal, scale=scale)
     return jax.shard_map(

@@ -125,10 +125,28 @@ def plan_axes(
     strategies: List[GraphStrategy] = []
     forbidden: Dict[Var, set] = {}
     prior_splits: Dict[Var, int] = {}
+    # Annotation pins RESERVE their tensor dim against every OTHER axis up
+    # front: an earlier-planned axis must not take a dim a later axis's
+    # annotation will pin (e.g. the data axis ZeRO-splitting expert-weight
+    # dim 0 that the expert annotation owns — the combined factor would
+    # overrun the dim).
+    planned_axes = {n for n, sz in topology.device_axes() if sz > 1}
+    pinned: Dict[Var, Dict[str, int]] = {}
+    for ax_name, fx in fixed_per_axis.items():
+        if ax_name not in planned_axes:
+            continue    # a size-1 axis never materialises its pin
+        for v, s in fx.items():
+            if s.is_split():
+                pinned.setdefault(v, {})[ax_name] = s.partition_dim
     for name, size in topology.device_axes():
         if size <= 1:
             continue
         fixed = fixed_per_axis.get(name, {})
+        axis_forbidden = {v: set(d) for v, d in forbidden.items()}
+        for v, by_axis in pinned.items():
+            reserved = {d for ax, d in by_axis.items() if ax != name}
+            if reserved:
+                axis_forbidden[v] = axis_forbidden.get(v, set()) | reserved
         if name == "seq":
             # Reserved: the sequence axis is owned by the ring-attention
             # rewrite (parallel/attention_motif.py). When the graph still
@@ -156,7 +174,8 @@ def plan_axes(
             gs = FastSpmdStrategy(graph, name, size, fixed).run()
         else:
             gs = CostSpmdStrategy(
-                graph, name, size, fixed=fixed, forbidden_dims=forbidden,
+                graph, name, size, fixed=fixed,
+                forbidden_dims=axis_forbidden,
                 mem_limit_bytes=mem_limit_bytes,
                 prior_var_splits=prior_splits,
             ).run()
@@ -310,7 +329,15 @@ def align_state_storage(
             if cur is not None and cur.is_split():
                 continue  # planner chose a storage split already
             shape = v.aval.shape
+            # Dims another axis already splits are off-limits (one mesh
+            # axis per tensor dim — adopting dim 0 here while the expert
+            # axis pins dim 0 would overrun the dim with the combined
+            # factor).
+            taken = {s.partition_dim for g in strategies if g is not gs
+                     if (s := g.var_strategies.get(v)) is not None
+                     and s.is_split()}
             if (out_s.partition_dim < len(shape)
+                    and out_s.partition_dim not in taken
                     and shape[out_s.partition_dim] % out_s.num_splits == 0):
                 gs.var_strategies[v] = out_s
                 changed += 1

@@ -209,11 +209,13 @@ class SpmdTransform:
             for i, eqn in enumerate(jaxpr.eqns):
                 if i in at_pv:
                     axis_name, m = at_pv[i]
-                    from tepdist_tpu.ops.ring_attention import ring_attention
-                    o = ring_attention(read(m.q), read(m.k), read(m.v),
-                                       mesh, axis_name, causal=m.causal,
-                                       scale=m.scale)
-                    write(m.out, o.astype(m.out.aval.dtype))
+                    from tepdist_tpu.parallel.attention_motif import (
+                        bind_motif_outputs,
+                        lower_motif_call,
+                    )
+                    o, lse = lower_motif_call(
+                        m, mesh, axis_name, read(m.q), read(m.k), read(m.v))
+                    bind_motif_outputs(m, eqn.outvars, o, lse, write)
                     continue
                 if i in skip_ids:
                     continue

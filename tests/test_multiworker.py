@@ -555,3 +555,220 @@ def test_mid_step_worker_death_detected_by_heartbeat(tmp_path, victim_ti):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
         got, jax.device_get(p))
+
+
+# ---------------------------------------------------------------------------
+# 4-worker scale-out (VERDICT r3 ask #4; reference: ExecutionCoordinator
+# arbitrary-N fan-out, pjrt/execution_coordinator.h:432-472, and the README
+# localhost-cluster pattern, README.md:96-117).
+
+def _spawn_fleet(n, extra_env=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.update(extra_env or {})
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ports, procs = [], []
+    for i in range(n):
+        port = _free_port()
+        ports.append(port)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "tepdist_tpu.rpc.server",
+             "--port", str(port), "--platform", "cpu",
+             "--task_index", str(i)],
+            env=env, cwd=root,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+    from tepdist_tpu.rpc.client import TepdistClient
+    for port in ports:
+        c = TepdistClient(f"127.0.0.1:{port}")
+        c.wait_ready(timeout=60)
+        c.close()
+    return ports, procs
+
+
+def _kill_fleet(procs):
+    for p in procs:
+        try:
+            p.send_signal(signal.SIGCONT)
+        except Exception:
+            pass
+        p.send_signal(signal.SIGKILL)
+        p.wait()
+
+
+def _mlp_setup(seed=0, d=32, batch=16):
+    def loss_fn(params, x, y):
+        h = x
+        for i in range(4):
+            h = jnp.tanh(h @ params[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    keys = jax.random.split(jax.random.PRNGKey(seed), 6)
+    params = {f"w{i}": jax.random.normal(keys[i], (d, d)) * 0.3
+              for i in range(4)}
+    x = jax.random.normal(keys[4], (batch, d))
+    y = jax.random.normal(keys[5], (batch, d))
+    return loss_fn, params, x, y
+
+
+def _cluster_of(ports):
+    return ClusterSpec([
+        WorkerSpec("127.0.0.1", p, [0], task_index=i)
+        for i, p in enumerate(ports)])
+
+
+@pytest.mark.parametrize("n_workers", [2, 4])
+def test_n_worker_pipeline_matches_local(n_workers):
+    """One stage per worker at N=2 and N=4: the coordinator fans the plan
+    out to all N processes and the trajectory equals the local reference."""
+    loss_fn, params, x, y = _mlp_setup(seed=7)
+    prog = plan_pipeline(loss_fn, n_workers, 2, params, x, y)
+    ports, procs = _spawn_fleet(n_workers)
+    try:
+        tx = optax.adam(1e-2)
+        sess = DistributedPipelineSession(prog, _cluster_of(ports),
+                                          optimizer=tx)
+        sess.load_variables(params)
+        losses = [sess.step(x, y) for _ in range(3)]
+        got = sess.fetch_variables()
+        sess.close()
+    finally:
+        _kill_fleet(procs)
+
+    def apply_fn(pp, ss, g):
+        u, ss = tx.update(g, ss, pp)
+        return optax.apply_updates(pp, u), ss
+
+    ref_step = jax.jit(prog.reference_step(apply_fn))
+    p, s = params, tx.init(params)
+    ref = []
+    for _ in range(3):
+        l, p, s = ref_step(p, s, x, y)
+        ref.append(float(l))
+    np.testing.assert_allclose(losses, ref, rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
+        got, jax.device_get(p))
+
+
+def test_coordinator_fanout_four_workers(tmp_path):
+    """ExecutionCoordinator fan-out at N=4: mesh init, module transfer,
+    remote execute and save against 3 slaves."""
+    from tepdist_tpu.runtime.coordinator import ExecutionCoordinator
+    from tepdist_tpu.rpc.jaxpr_serde import serialize_closed_jaxpr
+
+    ports, procs = _spawn_fleet(4, {"TEPDIST_CKPT_DIR": str(tmp_path)})
+    try:
+        coord = ExecutionCoordinator(_cluster_of(ports))
+        assert set(coord.clients) == {1, 2, 3}  # slaves (master = task 0)
+        coord.init_mesh_topology()
+        closed = jax.make_jaxpr(lambda x: x * 2)(jnp.zeros((4,)))
+        coord.transfer_module(serialize_closed_jaxpr(closed), module_id=7)
+        coord.transfer_var_arg_map({0: 0})
+        results = coord.execute_remote_plan()
+        assert len(results) == 3 and all(r.get("ok") for r in results)
+        coord.do_remote_save(max_to_keep=2, global_step=0)
+        coord.close()
+    finally:
+        _kill_fleet(procs)
+
+
+def test_elastic_redispatch_at_four_workers(tmp_path):
+    """Mid-run death at N=4: kill worker 2 of a 4-stage/4-worker session;
+    the elastic path re-dispatches the orphaned stage onto the 3 survivors
+    (union checkpoint restore) and the trajectory equals an uninterrupted
+    run — the N=2 elasticity story does not degenerate at larger fleets."""
+    loss_fn, params, x, y = _mlp_setup(seed=11)
+    prog = plan_pipeline(loss_fn, 4, 2, params, x, y)
+    tx = optax.adam(1e-2)
+    ports, procs = _spawn_fleet(4, {"TEPDIST_CKPT_DIR": str(tmp_path)})
+    try:
+        sess = DistributedPipelineSession(prog, _cluster_of(ports),
+                                          optimizer=tx, elastic=True,
+                                          autosave_every=1)
+        sess.load_variables(params)
+        losses = [sess.step(x, y) for _ in range(2)]
+        procs[2].send_signal(signal.SIGKILL)
+        procs[2].wait()
+        losses += [sess.step(x, y) for _ in range(2)]
+        assert sess.cluster.num_workers == 3  # really re-dispatched
+        got = sess.fetch_variables()
+        sess.close()
+    finally:
+        _kill_fleet(procs)
+
+    def apply_fn(pp, ss, g):
+        u, ss = tx.update(g, ss, pp)
+        return optax.apply_updates(pp, u), ss
+
+    ref_step = jax.jit(prog.reference_step(apply_fn))
+    p, s = params, tx.init(params)
+    ref = []
+    for _ in range(4):
+        l, p, s = ref_step(p, s, x, y)
+        ref.append(float(l))
+    np.testing.assert_allclose(losses, ref, rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
+        got, jax.device_get(p))
+
+
+def test_mid_step_death_at_four_workers(tmp_path):
+    """Mid-step wedge at N=4: worker 2 SIGSTOPs during ExecuteRemotePlan;
+    heartbeat detection + AbortStep wake the three blocked survivors and
+    re-dispatch runs on all of them — none may be mis-declared dead."""
+    import time as _time
+
+    loss_fn, params, x, y = _mlp_setup(seed=13)
+    prog = plan_pipeline(loss_fn, 4, 2, params, x, y)
+    tx = optax.adam(1e-2)
+    ports, procs = _spawn_fleet(4, {"TEPDIST_CKPT_DIR": str(tmp_path)})
+    try:
+        sess = DistributedPipelineSession(prog, _cluster_of(ports),
+                                          optimizer=tx, elastic=True,
+                                          autosave_every=1)
+        sess.health.interval = 0.5
+        sess.health.timeout = 0.5
+        sess.abort_grace_s = 5.0
+        sess.load_variables(params)
+        losses = [sess.step(x, y)]
+
+        victim_proc = procs[2]
+        victim = sess.clients[2].stub
+        orig_call = victim.call
+
+        def stopping_call(method, payload, timeout=300.0):
+            if method == "ExecuteRemotePlan":
+                victim_proc.send_signal(signal.SIGSTOP)
+            return orig_call(method, payload, timeout=timeout)
+
+        victim.call = stopping_call
+        t0 = _time.monotonic()
+        losses.append(sess.step(x, y))
+        detect_s = _time.monotonic() - t0
+        losses += [sess.step(x, y) for _ in range(2)]
+        assert sess.cluster.num_workers == 3
+        assert detect_s < 60.0, f"mid-step death took {detect_s:.1f}s"
+        got = sess.fetch_variables()
+        sess.close()
+    finally:
+        _kill_fleet(procs)
+
+    def apply_fn(pp, ss, g):
+        u, ss = tx.update(g, ss, pp)
+        return optax.apply_updates(pp, u), ss
+
+    ref_step = jax.jit(prog.reference_step(apply_fn))
+    p, s = params, tx.init(params)
+    ref = []
+    for _ in range(4):
+        l, p, s = ref_step(p, s, x, y)
+        ref.append(float(l))
+    np.testing.assert_allclose(losses, ref, rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
+        got, jax.device_get(p))
