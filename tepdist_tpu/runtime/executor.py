@@ -305,6 +305,29 @@ class PipelineExecutable:
             for p, i in zip(self._stage_ppos[s], self._stage_pidx[s]):
                 self._param_sharding[(s, i)] = self._pos_sharding(s, mod, p)
 
+        # Pre-bound per-task argument templates (ask #8: per-step dict
+        # lookups and sharding-rule re-derivation were measurable): one
+        # (kind, idx, pos) list per stage plus the batch placement cache.
+        batch_set_t = set(prog.batch_flat_indices)
+        self._arg_templates: List[List[Tuple[str, Optional[int], int]]] = []
+        self._batch_sharding: Dict[Tuple[int, int], NamedSharding] = {}
+        for s in range(S):
+            mod = prog.stages[s]
+            tpl: List[Tuple[str, Optional[int], int]] = []
+            for pos in range(len(mod.invars)):
+                src = mod.input_def_map[pos]
+                if src[0] == "arg":
+                    i = src[1]
+                    if i in batch_set_t:
+                        tpl.append(("batch", i, pos))
+                        self._batch_sharding[(s, pos)] = self._pos_sharding(
+                            s, mod, pos)
+                    else:
+                        tpl.append(("param", i, pos))
+                else:
+                    tpl.append(("wire", None, pos))
+            self._arg_templates.append(tpl)
+
         # Which cot positions are wired per stage (from the DAG build):
         for s in range(S):
             mod = prog.stages[s]
@@ -482,14 +505,27 @@ class PipelineExecutable:
         n_param_leaves = self.n_params
         bdim = prog.batch_dim
 
-        # SPLIT: micro-slice every batch leaf.
+        # SPLIT: micro-slice every batch leaf — ONE jitted dispatch per
+        # leaf (M separate slice ops serialized the step preamble).
+        if not hasattr(self, "_slicers"):
+            self._slicers = {}
         micro_slices: Dict[Tuple[int, int], Any] = {}
         for j, leaf in enumerate(batch_flat):
             i = n_param_leaves + j
-            msize = leaf.shape[bdim] // M
-            for m in range(M):
-                sl = jax.lax.slice_in_dim(leaf, m * msize, (m + 1) * msize,
-                                          axis=bdim)
+            sl_key = (i, tuple(leaf.shape), str(getattr(leaf, "dtype", "")))
+            if sl_key not in self._slicers:
+                msize = leaf.shape[bdim] // M
+
+                def make(msize=msize, bdim=bdim):
+                    def slicer(x):
+                        return tuple(
+                            jax.lax.slice_in_dim(x, m * msize,
+                                                 (m + 1) * msize, axis=bdim)
+                            for m in range(M))
+                    return jax.jit(slicer)
+
+                self._slicers[sl_key] = make()
+            for m, sl in enumerate(self._slicers[sl_key](leaf)):
                 micro_slices[(m, i)] = sl
 
         outputs: Dict[int, Tuple] = {}
@@ -497,25 +533,15 @@ class PipelineExecutable:
         batch_set = set(prog.batch_flat_indices)
 
         def stage_args(s: int, m: int, tid: int) -> List[Any]:
-            mod = prog.stages[s]
             node = self.dag.node(tid)
             args: List[Any] = []
-            for pos in range(len(mod.invars)):
-                src = mod.input_def_map[pos]
-                if src[0] == "arg":
-                    i = src[1]
-                    if i in batch_set:
-                        if self._tp_in_specs[s] is not None:
-                            # The stage planner may shard batch args over
-                            # the model axis too (e.g. sequence dim).
-                            val = jax.device_put(
-                                micro_slices[(m, i)],
-                                self._pos_sharding(s, mod, pos))
-                        else:
-                            val = self._put_stage(s, micro_slices[(m, i)])
-                    else:
-                        val = self._stage_param(s, i)
-                    args.append(val)
+            for kind, i, pos in self._arg_templates[s]:
+                if kind == "param":
+                    args.append(self._stage_param(s, i))
+                elif kind == "batch":
+                    args.append(jax.device_put(
+                        micro_slices[(m, i)],
+                        self._batch_sharding[(s, pos)]))
                 else:
                     pid, oi = node.input_specs[pos]
                     args.append(outputs[pid][oi])
@@ -596,7 +622,8 @@ class PipelineExecutable:
                 outputs.pop(rid, None)
 
         self.global_step += 1
-        loss = sum(jax.device_get(l) for l in losses) / M
+        # ONE host round trip for all micro losses.
+        loss = float(np.sum(jax.device_get(jnp.stack(losses)))) / M
         if debug:
             log.info("[ExecutePlan Duration] step=%d %.3f ms",
                      self.global_step, (_time.perf_counter() - t_step0) * 1e3)

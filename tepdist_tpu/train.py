@@ -39,13 +39,31 @@ class TrainingPlan:
     def variables(self):
         raise NotImplementedError
 
-    def save(self, directory: str, step: int, max_to_keep: int = 5) -> None:
+    def _device_state(self):
+        """Flat state leaves WITHOUT host transfer (the checkpoint writer
+        streams them device->host one variable at a time)."""
+        return jax.tree_util.tree_leaves(self.variables())
+
+    def save(self, directory: str, step: int, max_to_keep: int = 5,
+             block: bool = True):
+        """Checkpoint the training state. ``block=False`` snapshots
+        device->host now and writes on a background thread; returns an
+        AsyncSaveHandle (call .result() before shutdown)."""
         from tepdist_tpu.runtime.checkpoint import CheckpointUtil
 
-        flat = jax.tree_util.tree_leaves(self.variables())
-        CheckpointUtil(directory, max_to_keep).save(
-            step, {str(i): np.asarray(jax.device_get(l))
-                   for i, l in enumerate(flat)})
+        flat = self._device_state()
+        # One util per directory so overlapping async saves serialize on
+        # its lock (a fresh util per call would sidestep it).
+        self._ckpt_utils = getattr(self, "_ckpt_utils", {})
+        key = (directory, max_to_keep)
+        if key not in self._ckpt_utils:
+            self._ckpt_utils[key] = CheckpointUtil(directory, max_to_keep)
+        util = self._ckpt_utils[key]
+        variables = {str(i): l for i, l in enumerate(flat)}
+        if block:
+            util.save(step, variables)
+            return None
+        return util.save_async(step, variables)
 
     def restore(self, directory: str, step: int = -1) -> int:
         from tepdist_tpu.runtime.checkpoint import CheckpointUtil
@@ -99,6 +117,10 @@ class _SpmdTrainingPlan(TrainingPlan):
     def variables(self):
         return jax.tree_util.tree_unflatten(
             self._state_tree, [jax.device_get(v) for v in self._state])
+
+    def _device_state(self):
+        # Raw device arrays: the checkpoint writer fetches one at a time.
+        return list(self._state)
 
     def _load(self, variables) -> None:
         flat = jax.tree_util.tree_leaves(variables)

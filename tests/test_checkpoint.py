@@ -103,3 +103,146 @@ def test_save_sharded_pytree_round_trip(tmp_path, devices):
     assert step == 11
     np.testing.assert_array_equal(np.asarray(restored["a"]), np.asarray(x))
     assert float(restored["b"]) == 3.5
+
+
+def test_streaming_save_bounded_host_residency(tmp_path, devices, monkeypatch):
+    """VERDICT r3 weak #4: the sync save path streams variables
+    device->host ONE AT A TIME — at no point do more than 2 fetched host
+    copies coexist, so peak host memory is O(largest var), not O(state)."""
+    import gc
+    import weakref
+
+    import jax
+
+    from tepdist_tpu.runtime.checkpoint import CheckpointUtil
+
+    alive: set = set()
+    max_alive = [0]
+    orig_fetch = CheckpointUtil._fetch
+
+    def tracking_fetch(value):
+        gc.collect()    # give the writer's del its effect before counting
+        arr = orig_fetch(value)
+        token = id(arr)
+        alive.add(token)
+        weakref.finalize(arr, alive.discard, token)
+        max_alive[0] = max(max_alive[0], len(alive))
+        return arr
+
+    monkeypatch.setattr(CheckpointUtil, "_fetch",
+                        staticmethod(tracking_fetch))
+    variables = {
+        f"v{i}": jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(i), (512, 512)))
+        for i in range(8)
+    }
+    util = CheckpointUtil(str(tmp_path))
+    util.save(3, variables)
+    assert max_alive[0] <= 2, (
+        f"{max_alive[0]} fetched host copies coexisted — save is not "
+        "streaming")
+    data, step = CheckpointUtil(str(tmp_path)).restore()
+    assert step == 3
+    for i in range(8):
+        np.testing.assert_array_equal(
+            data[f"v{i}"], np.asarray(variables[f"v{i}"]))
+
+
+def test_async_save_overlap_and_restore(tmp_path):
+    """save_async returns immediately, serializes overlapping writes, and
+    the joined result restores exactly; errors surface in .result()."""
+    from tepdist_tpu.runtime.checkpoint import CheckpointUtil
+
+    util = CheckpointUtil(str(tmp_path), max_to_keep=5)
+    v1 = {"a": np.arange(10000, dtype=np.float32).reshape(100, 100)}
+    v2 = {"a": np.arange(10000, dtype=np.float32).reshape(100, 100) * 2}
+    h1 = util.save_async(1, v1)
+    h2 = util.save_async(2, v2)
+    p1, p2 = h1.result(60), h2.result(60)
+    assert h1.done() and h2.done()
+    assert p1.endswith(".npz") and p2.endswith(".npz")
+    assert util.steps() == [1, 2]
+    d1, _ = util.restore(1)
+    d2, _ = util.restore(2)
+    np.testing.assert_array_equal(d1["a"], v1["a"])
+    np.testing.assert_array_equal(d2["a"], v2["a"])
+
+
+def _mlp_setup_ckpt():
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, x, y):
+        h = x
+        for i in range(4):
+            h = jnp.tanh(h @ params[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    keys = jax.random.split(jax.random.PRNGKey(0), 6)
+    params = {f"w{i}": jax.random.normal(keys[i], (32, 32)) * 0.3
+              for i in range(4)}
+    x = jax.random.normal(keys[4], (16, 32))
+    y = jax.random.normal(keys[5], (16, 32))
+    return loss_fn, params, x, y
+
+
+def test_cross_mesh_restore_trajectory(tmp_path, devices):
+    """Save the FULL training state (adam moments) under a data=8 mesh,
+    restore onto a data=2 x model=4 mesh: continued trajectory equals an
+    uninterrupted run (VERDICT r3 weak #4 cross-topology contract)."""
+    import jax
+    import optax
+
+    from tepdist_tpu.core.mesh import MeshTopology
+    from tepdist_tpu.train import plan_training
+
+    loss_fn, params, x, y = _mlp_setup_ckpt()
+    tx = optax.adam(1e-2)
+    fresh = lambda: jax.tree_util.tree_map(np.array, params)
+
+    plan_a = plan_training(loss_fn, tx, fresh(), x, y,
+                           topology=MeshTopology([("data", 8)]),
+                           num_micro_batches=1)
+    [plan_a.step(x, y) for _ in range(2)]
+    h = plan_a.save(str(tmp_path), step=2, block=False)
+    assert h.result(60).endswith(".npz")
+
+    plan_b = plan_training(loss_fn, tx, fresh(), x, y,
+                           topology=MeshTopology([("data", 2),
+                                                  ("model", 4)]),
+                           num_micro_batches=1)
+    assert plan_b.restore(str(tmp_path)) == 2
+    cont = [plan_b.step(x, y) for _ in range(2)]
+
+    ref = plan_training(loss_fn, tx, fresh(), x, y,
+                        topology=MeshTopology([("data", 8)]),
+                        num_micro_batches=1)
+    base = [ref.step(x, y) for _ in range(4)]
+    np.testing.assert_allclose(cont, base[2:], rtol=2e-3)
+
+
+def test_cross_stage_shape_restore_trajectory(tmp_path, devices):
+    """Save under an SPMD mesh, restore onto a 2-STAGE task-graph
+    pipeline (different execution topology/stage shape); sgd (stateless)
+    so both runtimes share the checkpoint structure."""
+    import jax
+    import optax
+
+    from tepdist_tpu.train import plan_training
+
+    loss_fn, params, x, y = _mlp_setup_ckpt()
+    tx = optax.sgd(0.1)
+    fresh = lambda: jax.tree_util.tree_map(np.array, params)
+
+    plan_a = plan_training(loss_fn, tx, fresh(), x, y, num_micro_batches=1)
+    [plan_a.step(x, y) for _ in range(2)]
+    plan_a.save(str(tmp_path), step=2)
+
+    plan_b = plan_training(loss_fn, tx, fresh(), x, y, num_stages=2,
+                           num_micro_batches=2)
+    assert plan_b.restore(str(tmp_path)) == 2
+    cont = [plan_b.step(x, y) for _ in range(2)]
+
+    ref = plan_training(loss_fn, tx, fresh(), x, y, num_micro_batches=1)
+    base = [ref.step(x, y) for _ in range(4)]
+    np.testing.assert_allclose(cont, base[2:], rtol=2e-3)

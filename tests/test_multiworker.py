@@ -34,6 +34,9 @@ def two_workers():
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # Exercise the device-direct data plane on the CPU fabric (the
+    # backend-dependent default would pick the host push here).
+    env["TEPDIST_DEVICE_TRANSFER"] = "1"
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     for i in range(2):
         port = _free_port()
@@ -567,6 +570,7 @@ def _spawn_fleet(n, extra_env=None):
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["TEPDIST_DEVICE_TRANSFER"] = "1"
     env.update(extra_env or {})
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ports, procs = [], []
