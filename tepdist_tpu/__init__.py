@@ -47,6 +47,9 @@ def __getattr__(name):
                                 "collective_pipeline"),
         "flash_attention": ("tepdist_tpu.ops.pallas.flash_attention",
                             "flash_attention"),
+        "flash_attention_with_lse": (
+            "tepdist_tpu.ops.pallas.flash_attention",
+            "flash_attention_with_lse"),
     }
     if name in lazy:
         import importlib
@@ -74,5 +77,6 @@ __all__ = [
     "ulysses_attention",
     "collective_pipeline",
     "flash_attention",
+    "flash_attention_with_lse",
     "__version__",
 ]

@@ -45,6 +45,9 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
     ("UNBALANCED_RATIO", float, 8.0, "pipeline stage flops imbalance tolerance"),
     ("NUM_MICRO_BATCHES", int, -1, "fixed micro-batch count (config mode)"),
     ("NUM_STAGES", int, -1, "fixed pipeline stage count (config mode)"),
+    ("INTRA_STAGE_TP", int, -1,
+     "model-parallel degree within each pipeline stage (stage x spmd "
+     "nesting, config mode; -1 = planner/exploration decides)"),
     ("MICRO_NUM_LIMIT", int, 2, "max in-flight micro-batches (1F1B window)"),
     ("GROUP_SCHED_COUNT", int, 3, "candidate schedules tried by TaskScheduler"),
     ("PP_BANDWIDTH", float, 0.0, "pipeline xfer bandwidth GB/s override "

@@ -137,10 +137,22 @@ class _PipelineTrainingPlan(TrainingPlan):
         return self._exe.step(*batch)
 
     def variables(self):
-        return self._exe.fetch_variables()
+        """Same (params, opt_state) contract as the SPMD plan: per-stage
+        optax states are assembled into one global state whose flat
+        leaves align with the SPMD runtime's — pipeline checkpoints are
+        cross-runtime restorable with STATEFUL optimizers."""
+        if self._exe.optimizer is not None:
+            return (self._exe.fetch_variables(),
+                    self._exe.fetch_opt_state())
+        return (self._exe.fetch_variables(),)
 
     def _load(self, variables) -> None:
-        self._exe.load_variables(variables)
+        if self._exe.optimizer is not None:
+            params, opt_state = variables
+            self._exe.load_variables(params)   # re-inits per-stage states
+            self._exe.load_opt_state(opt_state)
+        else:
+            self._exe.load_variables(variables[0])
 
 
 def explore_parallelism(
@@ -462,11 +474,14 @@ def plan_training(
         M = num_micro_batches or (
             env.num_micro_batches if env.num_micro_batches > 0 else 2)
         prog = plan_pipeline(loss_fn, num_stages, M, params, *example_batch)
-        # Stage x TP nesting: explicit arg, the exploration winner, or a
-        # 'model' axis on a caller-provided topology.
+        # Stage x TP nesting: explicit arg, the exploration winner, a
+        # 'model' axis on a caller-provided topology, or the
+        # INTRA_STAGE_TP env (config mode, like NUM_STAGES).
         tp = intra_stage_tp
         if tp is None and topology is not None:
             tp = dict(topology.device_axes()).get("model", 1)
+        if tp is None and env.intra_stage_tp > 0:
+            tp = env.intra_stage_tp
         exe = PipelineExecutable(prog, devices=devices, optimizer=optimizer,
                                  intra_stage_tp=tp or 1,
                                  stage_var_mem_limit=var_mem_limit)

@@ -223,15 +223,17 @@ def test_cross_mesh_restore_trajectory(tmp_path, devices):
 
 def test_cross_stage_shape_restore_trajectory(tmp_path, devices):
     """Save under an SPMD mesh, restore onto a 2-STAGE task-graph
-    pipeline (different execution topology/stage shape); sgd (stateless)
-    so both runtimes share the checkpoint structure."""
+    pipeline (different execution topology/stage shape) WITH a stateful
+    optimizer — the pipeline runtime assembles/scatters its per-stage
+    optax states into the same flat-leaf structure the SPMD runtime
+    checkpoints (adam moments survive the runtime switch)."""
     import jax
     import optax
 
     from tepdist_tpu.train import plan_training
 
     loss_fn, params, x, y = _mlp_setup_ckpt()
-    tx = optax.sgd(0.1)
+    tx = optax.adam(1e-2)
     fresh = lambda: jax.tree_util.tree_map(np.array, params)
 
     plan_a = plan_training(loss_fn, tx, fresh(), x, y, num_micro_batches=1)
@@ -244,5 +246,33 @@ def test_cross_stage_shape_restore_trajectory(tmp_path, devices):
     cont = [plan_b.step(x, y) for _ in range(2)]
 
     ref = plan_training(loss_fn, tx, fresh(), x, y, num_micro_batches=1)
+    base = [ref.step(x, y) for _ in range(4)]
+    np.testing.assert_allclose(cont, base[2:], rtol=2e-3)
+
+
+def test_pipeline_to_spmd_restore_trajectory(tmp_path, devices):
+    """The reverse direction: save from the 2-stage PIPELINE runtime
+    (per-stage adam states assembled to the global structure), restore
+    into an SPMD plan, trajectories equal."""
+    import jax
+    import optax
+
+    from tepdist_tpu.train import plan_training
+
+    loss_fn, params, x, y = _mlp_setup_ckpt()
+    tx = optax.adam(1e-2)
+    fresh = lambda: jax.tree_util.tree_map(np.array, params)
+
+    plan_a = plan_training(loss_fn, tx, fresh(), x, y, num_stages=2,
+                           num_micro_batches=2)
+    [plan_a.step(x, y) for _ in range(2)]
+    plan_a.save(str(tmp_path), step=2)
+
+    plan_b = plan_training(loss_fn, tx, fresh(), x, y, num_micro_batches=1)
+    assert plan_b.restore(str(tmp_path)) == 2
+    cont = [plan_b.step(x, y) for _ in range(2)]
+
+    ref = plan_training(loss_fn, tx, fresh(), x, y, num_stages=2,
+                        num_micro_batches=2)
     base = [ref.step(x, y) for _ in range(4)]
     np.testing.assert_allclose(cont, base[2:], rtol=2e-3)

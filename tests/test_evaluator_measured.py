@@ -129,3 +129,98 @@ def test_exploration_ranking_matches_measured_argmin(devices):
     # 3. Comm-bearing plans expose nonzero collective time.
     for name, c in evals.items():
         assert c.coll_ratio > 0.0, f"{name} priced zero comm"
+
+
+@pytest.mark.parametrize("n_devices,tol", [(2, 0.25), (4, 0.20), (8, 0.15)])
+def test_explore_candidate_ranking_vs_measured(devices, n_devices, tol,
+                                               monkeypatch):
+    """VERDICT r3 ask #9: the PIPELINE-vs-SPMD exploration ranking
+    (train.explore_parallelism's candidate list) validated against
+    measured CPU-mesh step times on three topologies per device count,
+    with tolerance TIGHTENING as devices grow (a wrong call costs more
+    at scale). For each n, three genuinely different candidates are
+    measured — pure dp, dp x model, and a 2-stage pipeline — and the
+    evaluator's argmin must measure within tol of the true best."""
+    if len(devices) < n_devices:
+        pytest.skip(f"needs {n_devices} devices")
+    from tepdist_tpu.train import explore_parallelism, plan_training
+
+    params = gpt2.init_params(CFG, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(CFG, BATCH, SEQ)
+    tx = optax.sgd(1e-3)
+    loss = lambda p, t: gpt2.loss_fn(p, t, CFG)
+
+    best = explore_parallelism(loss, params, tokens, n_devices=n_devices,
+                               num_micro_batches=4)
+    cands = best["candidates"]
+
+    def find_spmd(axes):
+        for c in cands:
+            if (c["kind"] == "spmd"
+                    and list(c["topology"].device_axes()) == axes):
+                return c
+        return None
+
+    def find_pipe(S, M, tp=1):
+        for c in cands:
+            if (c["kind"] == "pipeline" and c["num_stages"] == S
+                    and c["num_micro_batches"] == M
+                    and c.get("intra_tp", 1) == tp):
+                return c
+        return None
+
+    chosen = {}
+    c = find_spmd([("data", n_devices)])
+    if c is not None:
+        chosen["dp"] = c
+    if n_devices >= 4:
+        c = find_spmd([("data", n_devices // 2), ("model", 2)])
+    else:
+        c = find_spmd([("model", n_devices)])
+    if c is not None:
+        chosen["mixed"] = c
+    c = find_pipe(2, 4)
+    if c is not None:
+        chosen["pipe"] = c
+    assert len(chosen) >= 3, f"missing candidates: {sorted(chosen)}"
+
+    def measure(c):
+        import numpy as _np
+        fresh = jax.tree_util.tree_map(_np.array, params)
+        if c["kind"] == "spmd":
+            plan = plan_training(loss, tx, fresh, tokens,
+                                 topology=c["topology"],
+                                 num_micro_batches=1,
+                                 devices=devices[:n_devices])
+        else:
+            plan = plan_training(loss, tx, fresh, tokens,
+                                 num_stages=c["num_stages"],
+                                 num_micro_batches=c["num_micro_batches"],
+                                 intra_stage_tp=c.get("intra_tp", 1),
+                                 devices=devices[:n_devices])
+        for _ in range(2):
+            plan.step(tokens)
+        best_t = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(3):
+                plan.step(tokens)
+            dt = (time.perf_counter() - t0) / 3
+            best_t = dt if best_t is None else min(best_t, dt)
+        return best_t
+
+    meas = {k: measure(c) for k, c in chosen.items()}
+    evals = {k: c["cost"].total_duration for k, c in chosen.items()}
+    eval_best = min(evals, key=evals.get)
+    meas_best = min(meas.values())
+    if meas[eval_best] > (1.0 + tol) * meas_best:
+        # Transient host load can skew ms-scale CPU timings; one fresh
+        # round, keeping each candidate's best, before judging.
+        meas = {k: min(meas[k], measure(c)) for k, c in chosen.items()}
+        meas_best = min(meas.values())
+    assert meas[eval_best] <= (1.0 + tol) * meas_best, (
+        f"n={n_devices}: evaluator picked {eval_best}; "
+        f"eval={ {k: round(v, 6) for k, v in evals.items()} } "
+        f"meas={ {k: round(v * 1e3, 1) for k, v in meas.items()} }")
+    # The analytic costs must discriminate across the candidate kinds.
+    assert max(evals.values()) / min(evals.values()) >= 1.1

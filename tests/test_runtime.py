@@ -379,3 +379,26 @@ def test_executor_pp_dp_tp_matches(prog_big, devices):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
         got, jax.device_get(ref_p))
+
+
+def test_intra_stage_tp_env_knob(prog_big, devices):
+    """INTRA_STAGE_TP env engages stage x TP in config mode (parity with
+    NUM_STAGES-style knobs)."""
+    import optax
+
+    from tepdist_tpu.core.service_env import ServiceEnv
+    from tepdist_tpu.train import plan_training
+
+    loss_fn, params, x, y = _mlp4_big()
+    try:
+        ServiceEnv.reset({"INTRA_STAGE_TP": "2", "VAR_MEM_LIMIT": str(6 << 20)})
+        plan = plan_training(loss_fn, optax.sgd(0.1),
+                             jax.tree_util.tree_map(np.array, params),
+                             x, y, num_stages=2, num_micro_batches=2,
+                             devices=devices[:4])
+        assert plan._exe.tp == 2
+        l0 = plan.step(x, y)
+        l1 = plan.step(x, y)
+        assert l1 < l0
+    finally:
+        ServiceEnv.reset({})
