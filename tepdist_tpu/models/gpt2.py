@@ -325,25 +325,71 @@ def stack_block_params(params, cfg: GPT2Config):
             for k in keys}
 
 
+# Megatron-style TP placement of the stacked block leaves over a model
+# axis: column-split the up-projections (their biases follow), row-split
+# the down-projections (GSPMD inserts the psum), replicate norms and
+# residual biases. Dims are relative to the [..., d_in, d_out] tail of
+# the [S, L/S, ...] stacked leaves. The FUSED qkv weight is special: its
+# column thirds are the Q/K/V slabs, so a column shard only aligns with
+# the later jnp.split when tp % 3 == 0 — otherwise it is row-split
+# (valid TP; one psum before the bias) to avoid boundary-crossing
+# reshards (r4 review finding).
+_TP_DIM_FROM_END = {
+    "mlp_fc_w": 1, "mlp_fc_b": 1,
+    "attn_proj_w": 2, "mlp_proj_w": 2,
+}
+
+
+def _tp_dim_from_end(name: str, tp: int) -> Optional[int]:
+    if name == "attn_qkv_w":
+        return 1 if tp % 3 == 0 else 2
+    if name == "attn_qkv_b":
+        return 1 if tp % 3 == 0 else None
+    return _TP_DIM_FROM_END.get(name)
+
+
 def shard_stacked_for_stages(params, cfg: GPT2Config, mesh,
-                             axis: str = "stage"):
+                             axis: str = "stage",
+                             model_axis: Optional[str] = None):
     """Split full params into (embed_leaves, stage-sharded stacked blocks)
-    for the collective pipeline. Validates device count and divisibility."""
+    for the collective pipeline. Validates device count and divisibility.
+
+    ``model_axis``: additionally shard each stage's weights over a model
+    axis of the SAME mesh (Megatron column/row pattern) — the PP x TP
+    placement `collective_pipeline(..., model_axis=...)` consumes."""
     from jax.sharding import NamedSharding, PartitionSpec
 
     S = mesh.shape[axis]
-    if len(mesh.devices.flat) != S:
-        raise ValueError(f"mesh axis {axis} has {S} entries but "
-                         f"{len(mesh.devices.flat)} devices")
+    tp = mesh.shape[model_axis] if model_axis else 1
+    if len(mesh.devices.flat) != S * tp:
+        raise ValueError(f"mesh has {len(mesh.devices.flat)} devices; "
+                         f"{axis}x{model_axis or '-'} covers {S * tp}")
     if cfg.n_layer % S:
         raise ValueError(f"n_layer={cfg.n_layer} not divisible by "
                          f"{S} stages")
     stacked = stack_block_params(params, cfg)
     stacked = jax.tree_util.tree_map(
         lambda a: a.reshape((S, cfg.n_layer // S) + a.shape[1:]), stacked)
-    sharding = NamedSharding(mesh, PartitionSpec(axis))
-    stacked = jax.tree_util.tree_map(
-        lambda a: jax.device_put(a, sharding), stacked)
+
+    def spec_for(name, a):
+        parts = [axis] + [None] * (a.ndim - 1)
+        d_from_end = _tp_dim_from_end(name, tp) if model_axis else None
+        if d_from_end is not None:
+            d = a.ndim - d_from_end
+            if a.shape[d] % tp == 0:
+                parts[d] = model_axis
+            else:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "TP placement: %s dim %d (size %d) not divisible by "
+                    "%s=%d — leaf stays replicated over the model axis",
+                    name, d, a.shape[d], model_axis, tp)
+        while parts and parts[-1] is None:
+            parts.pop()
+        return PartitionSpec(*parts)
+
+    stacked = {k: jax.device_put(a, NamedSharding(mesh, spec_for(k, a)))
+               for k, a in stacked.items()}
     embed = {k: params[k] for k in ("wte", "wpe", "ln_f_g", "ln_f_b")}
     return embed, stacked
 
@@ -363,11 +409,14 @@ def make_stage_fn(cfg: GPT2Config, layers_per_stage: int):
 
 
 def pipelined_loss_fn(params, stacked_blocks, tokens, cfg: GPT2Config,
-                      mesh, num_micro: int, axis: str = "stage"):
+                      mesh, num_micro: int, axis: str = "stage",
+                      model_axis: Optional[str] = None):
     """Next-token CE with the block stack run as a collective pipeline.
 
     ``params``: embedding/final-norm leaves (wte/wpe/ln_f_*), replicated.
-    ``stacked_blocks``: [S, L/S, ...] leaves sharded over ``axis``.
+    ``stacked_blocks``: [S, L/S, ...] leaves sharded over ``axis`` (and,
+    with ``model_axis``, Megatron-sharded over it — PP x TP in one jit;
+    use shard_stacked_for_stages(..., model_axis=...) for the placement).
     """
     from tepdist_tpu.ops.collective_pipeline import collective_pipeline
 
@@ -383,7 +432,8 @@ def pipelined_loss_fn(params, stacked_blocks, tokens, cfg: GPT2Config,
     mb = B // num_micro
     x_micro = x.reshape(num_micro, mb, T, cfg.n_embd)
     pipelined = collective_pipeline(
-        make_stage_fn(cfg, layers_per_stage), mesh, axis=axis)
+        make_stage_fn(cfg, layers_per_stage), mesh, axis=axis,
+        model_axis=model_axis)
     y_micro = pipelined(stacked_blocks, x_micro)
     y = y_micro.reshape(B, T, cfg.n_embd)
     y = _layer_norm(y, params["ln_f_g"], params["ln_f_b"])

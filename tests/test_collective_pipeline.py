@@ -219,3 +219,45 @@ def test_pipeline_pp_x_dp_x_tp_hybrid(devices):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
         g1, g2)
+
+
+def test_gpt2_collective_pipeline_pp_x_tp_matches_dense(devices):
+    """GPT-2 PP x TP in ONE jit with AUTOMATIC Megatron placement:
+    shard_stacked_for_stages(model_axis=...) column/row-splits the block
+    weights and the pipelined loss matches the dense loss exactly."""
+    import dataclasses
+
+    from tepdist_tpu.models import gpt2
+
+    cfg = dataclasses.replace(gpt2.CONFIGS["test"], n_layer=2)
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = gpt2.fake_batch(cfg, 8, 32)
+    mesh = Mesh(np.array(devices[:4]).reshape(2, 2),
+                axis_names=("stage", "model"))
+    embed, stacked = gpt2.shard_stacked_for_stages(
+        params, cfg, mesh, model_axis="model")
+    # The TP placement really engaged (qkv row-split at tp=2 — column
+    # thirds only align when tp %% 3 == 0; mlp column-split).
+    assert "model" in tuple(stacked["attn_qkv_w"].sharding.spec)
+    assert "model" in tuple(stacked["mlp_fc_w"].sharding.spec)
+    l = jax.jit(lambda e, b, t: gpt2.pipelined_loss_fn(
+        e, b, t, cfg, mesh, num_micro=2, model_axis="model"))(
+        embed, stacked, tokens)
+    dense = gpt2.loss_fn(params, tokens, cfg)
+    np.testing.assert_allclose(float(l), float(dense), rtol=2e-5)
+
+    # Gradients through the PP x TP pipeline equal the DENSE gradients
+    # mapped onto the stacked [S, L/S, ...] layout (a wrong psum factor
+    # on any sharded leaf would show here).
+    g = jax.grad(lambda b: gpt2.pipelined_loss_fn(
+        embed, b, tokens, cfg, mesh, num_micro=2, model_axis="model"))(
+        stacked)
+    gd = jax.grad(lambda p: gpt2.loss_fn(p, tokens, cfg))(params)
+    S = 2
+    for k, gs in g.items():
+        dense_stack = np.stack(
+            [np.asarray(gd[f"h{i}"][k]) for i in range(cfg.n_layer)])
+        dense_stack = dense_stack.reshape(
+            (S, cfg.n_layer // S) + dense_stack.shape[1:])
+        np.testing.assert_allclose(np.asarray(gs), dense_stack,
+                                   rtol=2e-4, atol=1e-6)
