@@ -163,8 +163,9 @@ def _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret):
         kernel,
         # The name tags the eqn so the seq-axis planner can motif-match
         # flash call sites in traced graphs (parallel/attention_motif.py)
-        # — causal flag and softmax scale ride along for the rewrite.
-        name=f"tepdist_flash_fwd__c{int(causal)}__s{scale!r}",
+        # — causal flag, softmax scale and head count ride along for the
+        # rewrite (H lets the ulysses lowering un-flatten [B*H, T, D]).
+        name=f"tepdist_flash_fwd__c{int(causal)}__s{scale!r}__h{H}",
         grid=(B * H, T // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),

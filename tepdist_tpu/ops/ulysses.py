@@ -20,7 +20,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
                    scale: Optional[float],
-                   inner: Optional[Callable]):
+                   inner: Optional[Callable],
+                   return_lse: bool = False):
     # Local shapes: [B, H, T/P, D]. all_to_all: split heads, gather seq.
     def to_heads(x):
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
@@ -31,6 +32,17 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
                               tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)   # [B, H/P, T, D]
+    if return_lse:
+        from tepdist_tpu.ops.pallas.flash_attention import (
+            flash_attention_with_lse,
+        )
+        fn = inner or functools.partial(flash_attention_with_lse,
+                                        causal=causal, scale=scale)
+        oh, lseh = fn(qh, kh, vh)                        # lse [B, H/P, T]
+        # Transport the LSE back with the same head<->seq all-to-all
+        # (one trailing singleton dim to match the 4-d transpose).
+        lse = to_seq(lseh[..., None])[..., 0]            # [B, H, T/P]
+        return to_seq(oh), lse
     if inner is None:
         from tepdist_tpu.ops.ring_attention import reference_attention
         oh = reference_attention(qh, kh, vh, causal=causal, scale=scale)
@@ -41,22 +53,26 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
                       causal: bool = True, scale: Optional[float] = None,
-                      inner: Optional[Callable] = None):
+                      inner: Optional[Callable] = None,
+                      return_lse: bool = False):
     """Sequence-parallel attention via double all-to-all. q,k,v: [B,H,T,D]
     with T sharded over ``axis_name``; H must be divisible by the axis size.
     ``inner`` optionally overrides the local attention (e.g. a pallas flash
-    kernel)."""
+    kernel). ``return_lse``: also return the [B, H, T] log-sum-exp —
+    ``inner`` must then return (o, lse) (default: the pallas
+    flash_attention_with_lse)."""
     H = q.shape[1]
     size = mesh.shape[axis_name]
     if H % size != 0:
         raise ValueError(f"heads {H} not divisible by axis {axis_name}={size}")
     spec = P(None, None, axis_name, None)
     fn = functools.partial(_ulysses_local, axis_name=axis_name,
-                           causal=causal, scale=scale, inner=inner)
+                           causal=causal, scale=scale, inner=inner,
+                           return_lse=return_lse)
     return jax.shard_map(
         fn, mesh=mesh,
         in_specs=(spec, spec, spec),
-        out_specs=spec,
+        out_specs=(spec, P(None, None, axis_name)) if return_lse else spec,
         # pallas_call inner kernels don't annotate varying-mesh-axes (vma);
         # skip the check so flash-attention inners compose.
         check_vma=False,

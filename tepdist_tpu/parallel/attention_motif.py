@@ -61,6 +61,11 @@ class AttentionMotif:
     seq_len: int
     flash: bool = False        # single tagged pallas_call node
     seq_dim: int = 2           # T position: 2 in [B,H,T,D], 1 in [BH,T,D]
+    n_head: Optional[int] = None   # known for einsum + tagged-flash motifs
+    # Chosen sequence-parallel algorithm: "ring" (K/V rotation, hops
+    # overlap block compute) or "ulysses" (head<->seq all-to-alls, full
+    # local sequence) — picked per plan by comparing priced comm.
+    impl: str = "ring"
 
 
 def _is_qk_dot(node) -> bool:
@@ -133,13 +138,34 @@ def _flash_lse_escapes(graph: JaxprGraph, node) -> bool:
 
 
 def lower_motif_call(m: "AttentionMotif", mesh, axis_name: str, q, k, v):
-    """Lower one motif to ring attention (shared by the two rewrite
-    paths: attention_motif.build_ring_rewritten and
+    """Lower one motif to its chosen sequence-parallel algorithm (shared
+    by the two rewrite paths: attention_motif.build_ring_rewritten and
     SpmdTransform.executable). Returns (o, lse_or_None): flash motifs run
-    the PALLAS inner on their [B*H, T, D] layout and return the global
-    LSE so a live residual consumer can be re-bound."""
+    the PALLAS inner on their [B*H, T, D] layout and (ring only) return
+    the global LSE so a live residual consumer can be re-bound."""
     from tepdist_tpu.ops.ring_attention import ring_attention
+    from tepdist_tpu.ops.ulysses import ulysses_attention
 
+    if m.impl == "ulysses":
+        if m.flash:
+            # Un-flatten [B*H, T, D] via the tagged head count so the
+            # head<->seq all-to-all has a head dim to split; the pallas
+            # inner returns (o, lse) so a live residual consumer can be
+            # re-bound just like the ring path.
+            from tepdist_tpu.ops.pallas.flash_attention import (
+                flash_attention_with_lse,
+            )
+            BH, T, D = q.shape
+            H = m.n_head
+            q4, k4, v4 = (x.reshape(BH // H, H, T, D) for x in (q, k, v))
+            o4, lse4 = ulysses_attention(
+                q4, k4, v4, mesh, axis_name, causal=m.causal,
+                scale=m.scale, return_lse=True,
+                inner=lambda a, b, c: flash_attention_with_lse(
+                    a, b, c, causal=m.causal, scale=m.scale))
+            return o4.reshape(BH, T, D), lse4.reshape(BH, T)
+        return ulysses_attention(q, k, v, mesh, axis_name,
+                                 causal=m.causal, scale=m.scale), None
     if m.flash:
         ob, lseb = ring_attention(q[None], k[None], v[None], mesh,
                                   axis_name, causal=m.causal, scale=m.scale,
@@ -190,6 +216,8 @@ def detect_motifs(graph: JaxprGraph,
             parts = str(name).split("__")
             causal = bool(int(parts[1][1:]))
             scale = float(parts[2][1:])
+            n_head = (int(parts[3][1:]) if len(parts) > 3
+                      and parts[3].startswith("h") else None)
         except (IndexError, ValueError):
             continue
         if len(node.invars) < 3 or not all(
@@ -208,7 +236,8 @@ def detect_motifs(graph: JaxprGraph,
             qk_id=node.id, pv_id=node.id, member_ids={node.id},
             q=q_var, k=k_var, v=v_var, out=node.outvars[0],
             causal=causal, scale=scale,
-            seq_len=int(q_var.aval.shape[1]), flash=True, seq_dim=1))
+            seq_len=int(q_var.aval.shape[1]), flash=True, seq_dim=1,
+            n_head=n_head))
         claimed.add(node.id)
     for pv in graph.nodes:
         if not _is_pv_dot(pv) or pv.id in claimed:
@@ -310,7 +339,8 @@ def detect_motifs(graph: JaxprGraph,
             qk_id=qk.id, pv_id=pv.id, member_ids=members,
             q=q_var, k=k_var, v=v_var, out=pv.outvars[0],
             causal=has_mask, scale=scale,
-            seq_len=int(q_var.aval.shape[2])))
+            seq_len=int(q_var.aval.shape[2]),
+            n_head=int(q_var.aval.shape[1])))
         claimed.update(members)
     return motifs
 
@@ -357,6 +387,44 @@ def ring_comm_cost(motifs: List[AttentionMotif], num_splits: int,
     return t
 
 
+def ulysses_comm_cost(motifs: List[AttentionMotif], num_splits: int,
+                      spec=None, with_backward: bool = False) -> float:
+    """Ulysses comm per motif: 4 head<->seq all-to-alls forward (q, k, v
+    in; o out), fully EXPOSED (a2a -> compute -> a2a is serial, unlike the
+    ring's overlapped hops); the backward's transposed a2as double it.
+    inf when any motif's head count does not divide."""
+    from tepdist_tpu.graph.cost import aval_bytes
+    from tepdist_tpu.parallel.performance_utils import PerfUtils, chip_spec
+
+    spec = spec or chip_spec()
+    t = 0.0
+    for m in motifs:
+        if num_splits <= 1:
+            continue
+        if not m.n_head or m.n_head % num_splits:
+            return float("inf")
+        local_bytes = aval_bytes(m.q.aval) / num_splits
+        one = PerfUtils.all_to_all_cost(local_bytes, num_splits, spec)
+        t += 4.0 * one
+        if with_backward:
+            t += 4.0 * one
+    return t
+
+
+def best_seq_comm(motifs: List[AttentionMotif], num_splits: int,
+                  spec=None, with_backward: bool = False
+                  ) -> Tuple[str, float]:
+    """(impl, seconds): the cheaper of ring and ulysses for this motif
+    set. Ring usually wins (hops overlap block compute and it moves only
+    K/V); ulysses can win at short sequence / many heads / large P where
+    the ring's (P-1) serialized latencies dominate."""
+    ring = ring_comm_cost(motifs, num_splits, spec,
+                          with_backward=with_backward)
+    uly = ulysses_comm_cost(motifs, num_splits, spec,
+                            with_backward=with_backward)
+    return ("ulysses", uly) if uly < ring else ("ring", ring)
+
+
 def build_seq_strategy(graph: JaxprGraph, num_splits: int,
                        motifs: Optional[List[AttentionMotif]] = None,
                        chip=None) -> "GraphStrategy":
@@ -388,9 +456,17 @@ def build_seq_strategy(graph: JaxprGraph, num_splits: int,
         for nid in m.member_ids:
             if nid != m.pv_id:
                 gs.node_out.pop(nid, None)
+    # Choose AND price fwd+bwd: the lowered rewrite is differentiated
+    # (both directions run), and the exploration path prices rival
+    # candidates with_backward=True — a fwd-only argmin here could pick
+    # an algorithm the candidate was not priced with.
+    impl, comm = best_seq_comm(motifs, num_splits, chip,
+                               with_backward=True)
+    for m in motifs:
+        m.impl = impl
     gs.motifs = motifs
-    gs.comm_cost = ring_comm_cost(motifs, num_splits, chip)
-    gs.ilp_status = "seq-ring"
+    gs.comm_cost = comm
+    gs.ilp_status = f"seq-{impl}"
     return gs
 
 

@@ -205,7 +205,7 @@ def explore_parallelism(
             topo = MeshTopology(axes)
             try:
                 from tepdist_tpu.parallel.attention_motif import (
-                    ring_comm_cost,
+                    best_seq_comm,
                 )
                 from tepdist_tpu.parallel.evaluator import Cost
                 from tepdist_tpu.parallel.performance_utils import (
@@ -223,7 +223,8 @@ def explore_parallelism(
                 # to the fwd-seeded propagation, so the generic evaluator
                 # would overprice seq compute.
                 spec = chip_spec()
-                comm = ring_comm_cost(motifs, s, spec, with_backward=True)
+                _impl, comm = best_seq_comm(motifs, s, spec,
+                                            with_backward=True)
                 if d > 1:
                     topo_d = MeshTopology([("data", d)])
                     gs_d = plan_axes(graph, topo_d, None, "cost")[0]
@@ -425,11 +426,19 @@ def plan_training(
             detect_motifs,
         )
 
+        from tepdist_tpu.parallel.attention_motif import best_seq_comm
+
         g_loss, _, _ = _tg(loss_fn, params, *example_batch)
         motifs = detect_motifs(g_loss)
         if not motifs:
             raise ValueError("topology has a 'seq' axis but the loss has "
                              "no rewritable attention motif")
+        seq_size = dict(topology.device_axes())["seq"]
+        # Lower to the PRICED winner (ring vs ulysses, fwd+bwd) — the
+        # executed algorithm must match what exploration/pricing assumed.
+        impl, _cost = best_seq_comm(motifs, seq_size, with_backward=True)
+        for m in motifs:
+            m.impl = impl
         seq_mesh = topology.to_jax_mesh(devices)
         _rw = build_ring_rewritten(g_loss, motifs, seq_mesh, "seq")
 
@@ -437,8 +446,8 @@ def plan_training(
             flat, _ = jax.tree_util.tree_flatten(((p, *b), {}))
             return _rw(*flat)[0]
 
-        log.info("seq axis: %d attention motif(s) -> ring attention",
-                 len(motifs))
+        log.info("seq axis: %d attention motif(s) -> %s attention",
+                 len(motifs), impl)
 
     # REMAT_POLICY knob: rematerialization trades FLOPs for activation
     # memory (jax.checkpoint; the stage modules already remat via VJP).

@@ -277,3 +277,60 @@ def test_flash_grad_graph_not_rewritable():
         params, toks)
     assert detect_motifs(ggrad) == []
     assert len(detect_motifs(ggrad, allow_escape=True)) >= cfg.n_layer
+
+
+def test_seq_impl_choice_ring_vs_ulysses():
+    """The seq strategy prices BOTH algorithms and returns the argmin;
+    indivisible head counts make ulysses infeasible (inf) so ring wins
+    regardless of shape."""
+    from tepdist_tpu.parallel.attention_motif import (
+        best_seq_comm,
+        ring_comm_cost,
+        ulysses_comm_cost,
+    )
+
+    def motifs_for(T, H):
+        cfg_t = dataclasses.replace(gpt2.CONFIGS["test"], n_ctx=T,
+                                    n_head=H, n_embd=H * 16)
+        params = gpt2.init_params(cfg_t, jax.random.PRNGKey(0))
+        toks = gpt2.fake_batch(cfg_t, 2, T)
+        graph, _, _ = trace_graph(
+            lambda p, t: gpt2.loss_fn(p, t, cfg_t), params, toks)
+        return detect_motifs(graph)
+
+    for (T, H, P) in [(8192, 4, 4), (256, 8, 8), (512, 4, 4)]:
+        ms = motifs_for(T, H)
+        impl, cost = best_seq_comm(ms, P)
+        ring = ring_comm_cost(ms, P)
+        uly = ulysses_comm_cost(ms, P)
+        want = "ulysses" if uly < ring else "ring"
+        assert impl == want and cost == min(ring, uly), (T, H, P)
+        assert np.isfinite(cost)
+    # Indivisible heads: ulysses infeasible -> ring regardless of shape.
+    ms = motifs_for(256, 3)
+    impl, cost = best_seq_comm(ms, 4)
+    assert impl == "ring" and np.isfinite(cost)
+
+
+def test_ulysses_lowering_matches_dense(devices):
+    """Force the ulysses lowering through the motif rewrite (einsum and
+    flash forms) and match the dense loss."""
+    from jax.sharding import Mesh
+
+    for attn in ("einsum", "flash"):
+        cfg = dataclasses.replace(gpt2.CONFIGS["test"], attn=attn,
+                                  n_ctx=256)
+        params = gpt2.init_params(cfg, jax.random.PRNGKey(3))
+        toks = gpt2.fake_batch(cfg, 2, 256)
+        loss = lambda p, t: gpt2.loss_fn(p, t, cfg)
+        graph, _, _ = trace_graph(loss, params, toks)
+        motifs = detect_motifs(graph)
+        assert motifs
+        for m in motifs:
+            m.impl = "ulysses"
+        mesh = Mesh(np.array(devices[:4]).reshape(4), ("seq",))
+        rw = build_ring_rewritten(graph, motifs, mesh, "seq")
+        flat = jax.tree_util.tree_leaves(((params, toks), {}))
+        np.testing.assert_allclose(float(rw(*flat)[0]),
+                                   float(loss(params, toks)), rtol=2e-5,
+                                   err_msg=f"attn={attn}")
