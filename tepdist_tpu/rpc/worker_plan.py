@@ -252,8 +252,13 @@ class WorkerPlan:
             pass
 
     def _place_local(self, val):
-        """Shard micro-batch tensors over local devices; replicate the rest."""
+        """Shard micro-batch tensors over local devices; replicate the
+        rest. Single-device workers still device_put numpy values — a
+        numpy arg re-pays host->device transfer + hashing on EVERY jit
+        call that consumes it (fwd AND its remat bwd)."""
         if self._intra is None:
+            if isinstance(val, np.ndarray):
+                return jax.device_put(val, self.servicer.devices[0])
             return val
         batch_sh, rep_sh = self._intra
         if (hasattr(val, "ndim") and val.ndim >= 1
@@ -291,8 +296,14 @@ class WorkerPlan:
                 if src[0] == "arg":
                     gi = src[1]
                     if gi in meta["batch_indices"]:
-                        args.append(self._place_local(self.raw.get(
-                            f"batch:{step}:{task['micro']}:{gi}")))
+                        key = f"batch:{step}:{task['micro']}:{gi}"
+                        val = self.raw.get(key)
+                        if isinstance(val, np.ndarray):
+                            # Cache the DEVICE copy: fwd and its remat
+                            # bwd both read this key.
+                            val = self._place_local(val)
+                            self.raw.put(key, val)
+                        args.append(val)
                     else:
                         args.append(self.servicer.variables[gi])
                 else:
