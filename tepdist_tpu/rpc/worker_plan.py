@@ -325,16 +325,10 @@ class WorkerPlan:
                 self._run_one(task, tt, tid, s, step, outputs, losses,
                               stage_args)
             except TimeoutError:
-                for f in self._send_futures:
-                    f.cancel()
-                self._send_futures.clear()
+                self._abandon_step(step)
                 raise
             except Exception as e:  # noqa: BLE001 — add task context
-                # Don't block on (or leak) queued notifications of a step
-                # that just failed; stale plan_gen makes them moot anyway.
-                for f in self._send_futures:
-                    f.cancel()
-                self._send_futures.clear()
+                self._abandon_step(step)
                 raise RuntimeError(
                     f"worker {self.task_index} failed at task "
                     f"{task['name']}#{tid} (step {step}): {e!r}") from e
@@ -503,6 +497,16 @@ class WorkerPlan:
 
         self._send_futures.append(self._send_pool.submit(notify))
         return True
+
+    def _abandon_step(self, step: int) -> None:
+        """Failed-step cleanup before propagating: cancel queued ticket
+        notifications (stale plan_gen makes them moot) and drop the
+        step's store entries — cached DEVICE batch copies must not stay
+        pinned until the next DispatchPlan."""
+        for f in self._send_futures:
+            f.cancel()
+        self._send_futures.clear()
+        self.raw.clear_step(step)
 
     def _join_sends(self) -> None:
         """Surface async notification errors at step end (a failed send
