@@ -96,6 +96,14 @@ def build_pipeline_task_dag(
                 if src[0] != "stage":
                     continue
                 t, k = src[1], src[2]
+                if tuple(stage_devices[t]) == tuple(stage_devices[s]):
+                    # Co-resident stages (interleaved placement, or a
+                    # shared device group): direct edge — a SEND/RECV
+                    # pair would bill simulated transfer time for a
+                    # local no-op (mirrors the cotangent path below).
+                    dag.add_edge(dag.node(maps.fwd_tasks[(t, m)]), fwd,
+                                 out_idx=k, arg_pos=pos)
+                    continue
                 key = ((t, k), m)
                 if key not in maps.recv_tasks:
                     b = aval_bytes(mod.invars[pos].aval)
@@ -139,8 +147,14 @@ def build_pipeline_task_dag(
                 src = mod.input_def_map[pos]
                 if src[0] == "stage":
                     key = ((src[1], src[2]), m)
-                    dag.add_edge(dag.node(maps.recv_tasks[key]), bwd,
-                                 out_idx=0, arg_pos=pos)
+                    if key in maps.recv_tasks:
+                        dag.add_edge(dag.node(maps.recv_tasks[key]), bwd,
+                                     out_idx=0, arg_pos=pos)
+                    else:
+                        # Co-resident producer: direct edge (no recv).
+                        dag.add_edge(
+                            dag.node(maps.fwd_tasks[(src[1], m)]), bwd,
+                            out_idx=src[2], arg_pos=pos)
             # Cotangent inputs for this stage's outputs, delivered by later
             # stages' bwd tasks (cross-stage -> Send/Recv pair).
             n_in = len(mod.invars)

@@ -51,6 +51,7 @@ class PipelineExecutable:
         intra_stage_dp: bool = True,
         intra_stage_tp: int = 1,
         stage_var_mem_limit: Optional[int] = None,
+        placement: str = "blocked",
     ):
         """``intra_stage_dp``: shard the micro-batch dim over each stage's
         device subset (PP x DP hybrid — the reference's nested split
@@ -70,13 +71,47 @@ class PipelineExecutable:
         ``stage_var_mem_limit``: per-device byte budget for each stage's
         variables, enforced inside the stage planner's ILP (reference:
         SplitPlanByMemCost / VAR_MEM_LIMIT) — weight TP emerges where
-        replication would not fit. Defaults to the VAR_MEM_LIMIT env."""
+        replication would not fit. Defaults to the VAR_MEM_LIMIT env.
+
+        ``placement``: "blocked" (contiguous device ranges, one stage per
+        group) or "interleaved" — VIRTUAL stages: plan MORE stages than
+        device groups and assign them round-robin (stage s -> group
+        s % G, the multiworker layout in-process); hops between
+        co-resident stages are direct edges (no send/recv). S must be a
+        multiple of the group count. NOTE: the greedy event scheduler
+        does not yet realize the Megatron interleaved-1F1B bubble gain
+        (NOTES_NEXT #7) — use this to run more stages than device groups,
+        not as a bubble optimization."""
         self.prog = prog
         S = prog.num_stages
         devices = list(devices if devices is not None else jax.devices())
-        if len(devices) < S:
-            raise ValueError(f"need >= {S} devices for {S} stages")
-        per = len(devices) // S
+        if placement not in ("blocked", "interleaved"):
+            raise ValueError(f"unknown placement {placement!r}")
+        if placement == "interleaved":
+            # Group count = min(devices, stages); each group hosts S/G
+            # virtual stages (round-robin). A non-dividing S would
+            # silently unbalance or collapse to G=1 — error like the
+            # blocked path's under-provisioning check does.
+            G = min(len(devices), S)
+            if S % G:
+                raise ValueError(
+                    f"interleaved placement needs num_stages ({S}) "
+                    f"divisible by the group count ({G} = min(devices, "
+                    f"stages)); pick a dividing stage count")
+            per_g = len(devices) // G
+            groups = [tuple(devices[g * per_g:(g + 1) * per_g])
+                      for g in range(G)]
+            self._stage_group = [s % G for s in range(S)]
+            devices_of_stage = [list(groups[self._stage_group[s]])
+                                for s in range(S)]
+            per = per_g
+        else:
+            if len(devices) < S:
+                raise ValueError(f"need >= {S} devices for {S} stages")
+            per = len(devices) // S
+            devices_of_stage = [devices[s * per:(s + 1) * per]
+                                for s in range(S)]
+            self._stage_group = list(range(S))
         tp = max(int(intra_stage_tp), 1)
         if per % tp:
             raise ValueError(
@@ -95,7 +130,7 @@ class PipelineExecutable:
         self.intra_dp = (intra_stage_dp and dp > 1 and micro_rows is not None
                          and micro_rows % dp == 0)
         for s in range(S):
-            devs = devices[s * per:(s + 1) * per]
+            devs = devices_of_stage[s]
             self.stage_devices.append(tuple(d.id for d in devs))
             if tp > 1:
                 mesh = Mesh(np.array(devs).reshape(dp, tp),
