@@ -415,30 +415,96 @@ def auto_parallel_explore(
     annotations: Optional[Dict[int, Dict[str, DimStrategy]]] = None,
     state_alias: Optional[Dict[int, int]] = None,
     num_micro_batches: int = 1,
+    devices=None,
     **example_kwargs,
-) -> ParallelPlan:
+) -> Any:
     """Exploration mode (reference: AutoParallel::RunExplorationlMode,
-    auto_parallel.cc:236): enumerate mesh-shape proposals
-    (GenerateSplitProposals), plan each, keep the Evaluator-minimal one."""
-    from tepdist_tpu.parallel.evaluator import Evaluator
+    auto_parallel.cc:236): enumerate proposals, plan each, keep the
+    Evaluator-minimal one — over the UNIFIED candidate space
+    (parallel/exploration.py), the same one ``train.plan_training`` and
+    the service's explore mode search.
+
+    When ``fn`` is a scalar-output loss of the form ``fn(params, *batch)``,
+    the space includes sequence-parallel meshes (priced with the
+    ring/Ulysses attention cost) and pipeline stage cuts; a pipeline
+    winner is returned as a :class:`~tepdist_tpu.parallel.exploration.
+    PipelineWinner` (call ``.build(optimizer)`` for the executable).
+    Non-scalar ``fn`` (e.g. an explicit grad fn) searches mesh
+    factorizations only — stage cuts need loss semantics.
+
+    SPMD/seq winners come back as a lowered :class:`ParallelPlan` with
+    ``.cost`` and ``.candidates`` attached."""
+    from tepdist_tpu.parallel.evaluator import Evaluator  # noqa: F401
+    from tepdist_tpu.parallel.exploration import (
+        PipelineWinner,
+        pipeline_candidates,
+        seq_candidates,
+        spmd_candidates,
+    )
     from tepdist_tpu.parallel.spmd_transform import SpmdTransform as _Xform
 
-    graph, in_tree, out_tree = trace_graph(fn, *example_args, **example_kwargs)
-    best = None
-    for topo in explore_topologies(num_devices):
-        try:
-            strategies = plan_axes(graph, topo, annotations, "cost")
-        except Exception as e:  # infeasible proposal (e.g. indivisible dims)
-            log.info("proposal %s failed: %s", topo, e)
-            continue
-        cost = Evaluator(topo).run(graph, strategies, num_micro_batches)
-        log.info("proposal %s -> duration=%.3e feasible=%s",
-                 topo, cost.total_duration, cost.memory_feasible)
-        if best is None or cost.key() < best[0].key():
-            best = (cost, topo, strategies)
-    if best is None:
+    graph, in_tree, out_tree = trace_graph(fn, *example_args,
+                                           **example_kwargs)
+    scalar_loss = (not example_kwargs and len(graph.outvars) == 1
+                   and graph.outvars[0].aval.shape == ()
+                   and len(example_args) >= 2)
+    candidates = spmd_candidates(graph, num_devices, annotations,
+                                 num_micro_batches)
+    if scalar_loss:
+        params, *batch = example_args
+        batch_rows = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        candidates += seq_candidates(graph, num_devices, batch_rows)
+        candidates += pipeline_candidates(
+            fn, params, tuple(batch), num_devices, batch_rows,
+            num_micro_batches if num_micro_batches > 1 else 4)
+    if not candidates:
         raise RuntimeError("no feasible topology proposal")
-    cost, topo, strategies = best
+    best = min(candidates, key=lambda c: c["cost"].key())
+    log.info("exploration winner: %s (duration %.3e s/step) of %d "
+             "proposals", best["kind"], best["cost"].total_duration,
+             len(candidates))
+
+    if best["kind"] == "pipeline":
+        params, *batch = example_args
+        return PipelineWinner(
+            num_stages=best["num_stages"],
+            num_micro_batches=best["num_micro_batches"],
+            intra_tp=best.get("intra_tp", 1),
+            cost=best["cost"], candidates=candidates,
+            loss_fn=fn, params=params, example_batch=tuple(batch))
+
+    topo = best["topology"]
+    strategies = best.get("strategies")
+    if strategies is None or any(n == "seq" and s > 1
+                                 for n, s in topo.device_axes()):
+        if any(n == "seq" and s > 1 for n, s in topo.device_axes()):
+            # Materialize the seq winner: rewrite the attention motifs to
+            # the priced ring/Ulysses algorithm BEFORE planning, so the
+            # sequence dim stays sharded through the rewritten collective
+            # (the same lowering plan_training applies).
+            from tepdist_tpu.parallel.attention_motif import (
+                best_seq_comm,
+                build_ring_rewritten,
+                detect_motifs,
+            )
+
+            motifs = detect_motifs(graph)
+            if not motifs:
+                raise RuntimeError("seq winner but no rewritable motif")
+            seq_size = dict(topo.device_axes())["seq"]
+            impl, _ = best_seq_comm(motifs, seq_size, with_backward=True)
+            for m in motifs:
+                m.impl = impl
+            mesh = topo.to_jax_mesh(
+                list(devices if devices is not None else jax.devices()))
+            rw = build_ring_rewritten(graph, motifs, mesh, "seq")
+
+            def fn_rw(*args, _rw=rw):
+                flat, _ = jax.tree_util.tree_flatten((args, {}))
+                return _rw(*flat)[0]
+
+            graph, in_tree, out_tree = trace_graph(fn_rw, *example_args)
+        strategies = plan_axes(graph, topo, annotations, "cost")
     xform = _Xform(graph, topo)
     sharding_plan = xform.lower(strategies, state_alias=state_alias)
     plan = ParallelPlan(
@@ -446,7 +512,8 @@ def auto_parallel_explore(
         sharding_plan=sharding_plan, in_tree=in_tree, out_tree=out_tree,
         mode="exploration",
     )
-    plan.cost = cost
+    plan.cost = best["cost"]
+    plan.candidates = candidates
     return plan
 
 

@@ -162,206 +162,15 @@ def explore_parallelism(
     n_devices: int,
     num_micro_batches: int = 4,
 ) -> Dict[str, Any]:
-    """Full exploration (reference: RunExplorationlMode over DeviceSplitPlan
-    proposals incl. pipeline levels): evaluate SPMD mesh factorizations AND
-    pipeline-stage proposals under the analytic cost model; return the
-    winner as {"kind": "spmd"|"pipeline", ...}."""
-    from tepdist_tpu.graph.jaxpr_graph import trace_graph
-    from tepdist_tpu.parallel.auto_parallel import (
-        explore_topologies,
-        plan_axes,
-    )
-    from tepdist_tpu.parallel.evaluator import Evaluator
-    from tepdist_tpu.parallel.pipeline import plan_pipeline
-    from tepdist_tpu.runtime.execution_plan import build_pipeline_task_dag
+    """Full exploration over the UNIFIED candidate space — SPMD mesh
+    factorizations, seq-parallel meshes, and pipeline stage cuts
+    (parallel/exploration.py; reference: RunExplorationlMode over
+    DeviceSplitPlan proposals incl. pipeline levels,
+    auto_parallel.cc:236)."""
+    from tepdist_tpu.parallel.exploration import explore
 
-    grad_fn = jax.value_and_grad(loss_fn)
-    graph, _, _ = trace_graph(grad_fn, params, *example_batch)
-    candidates: List[Dict[str, Any]] = []
-    for topo in explore_topologies(n_devices):
-        try:
-            strategies = plan_axes(graph, topo, None, "cost")
-            cost = Evaluator(topo).run(graph, strategies)
-            candidates.append({"kind": "spmd", "topology": topo,
-                               "cost": cost})
-        except Exception as e:  # noqa: BLE001 — infeasible proposal
-            log.info("spmd proposal %s failed: %s", topo, e)
-    batch0 = jax.tree_util.tree_leaves(example_batch)[0]
-    batch_rows = batch0.shape[0]
-    # Sequence-parallel proposals (SURVEY §5.7): when the loss contains
-    # attention motifs, data x seq meshes compete — the seq axis is priced
-    # with the ring-attention cost (fwd ring + reverse ring in backward).
-    from tepdist_tpu.parallel.attention_motif import detect_motifs
-
-    motifs = detect_motifs(graph, allow_escape=True)
-    if motifs:
-        for s in (2, 4, 8, 16):
-            if s > n_devices or n_devices % s:
-                continue
-            d = n_devices // s
-            if any(m.seq_len % s for m in motifs) or batch_rows % max(d, 1):
-                continue
-            axes = ([("data", d)] if d > 1 else []) + [("seq", s)]
-            topo = MeshTopology(axes)
-            try:
-                from tepdist_tpu.parallel.attention_motif import (
-                    best_seq_comm,
-                )
-                from tepdist_tpu.parallel.evaluator import Cost
-                from tepdist_tpu.parallel.performance_utils import (
-                    PerfUtils,
-                    chip_spec,
-                )
-                from tepdist_tpu.parallel.sync_free import (
-                    estimate_peak_activation_bytes,
-                )
-
-                # A data x seq mesh shards a transformer's whole compute
-                # (every tensor carries the batch or token dim); comm =
-                # the data axis's own pricing (grad psums) + the exposed
-                # ring (fwd + reverse) — the backward nodes are invisible
-                # to the fwd-seeded propagation, so the generic evaluator
-                # would overprice seq compute.
-                spec = chip_spec()
-                _impl, comm = best_seq_comm(motifs, s, spec,
-                                            with_backward=True)
-                if d > 1:
-                    topo_d = MeshTopology([("data", d)])
-                    gs_d = plan_axes(graph, topo_d, None, "cost")[0]
-                    # Same re-derived pricing the Evaluator applies to the
-                    # rival SPMD candidates (comm_cost alone is a lower
-                    # bound that reported 0 for comm-dominated plans).
-                    comm += Evaluator(topo_d).derived_comm(graph, gs_d)
-                # Same COMM_OVERLAP discount the Evaluator applies to the
-                # rival SPMD candidates — hand-priced candidates must not
-                # compete with undiscounted serial comm in the same argmin.
-                overlap = min(max(ServiceEnv.get().comm_overlap, 0.0), 1.0)
-                comm *= (1.0 - overlap)
-                compute_t = PerfUtils.compute_time(
-                    graph.total_flops() / n_devices, spec)
-                from tepdist_tpu.graph.cost import aval_bytes as _ab
-                var_bytes = sum(_ab(v.aval) for v in graph.invars)
-                act = estimate_peak_activation_bytes(graph) / n_devices
-                total = compute_t + comm
-                budget = spec.hbm_gb * 1e9 * 0.9
-                cost = Cost(
-                    total_duration=total,
-                    compute_efficiency=compute_t / total if total else 0.0,
-                    coll_ratio=comm / total if total else 0.0,
-                    bubble_ratio=0.0,
-                    peak_bytes_per_device=var_bytes + act,
-                    memory_feasible=var_bytes + act <= budget)
-                candidates.append({"kind": "spmd", "topology": topo,
-                                   "cost": cost})
-            except Exception as e:  # noqa: BLE001 — infeasible proposal
-                log.info("seq proposal seq=%d failed: %s", s, e)
-    for S in (2, 4, 8):
-        if S > n_devices or n_devices % S:
-            continue
-        per = n_devices // S
-        for M in {num_micro_batches, 2 * num_micro_batches}:
-            if batch_rows % M:
-                continue
-            try:
-                prog = plan_pipeline(loss_fn, S, M, params, *example_batch)
-            except Exception as e:  # noqa: BLE001
-                log.info("pipeline proposal S=%d M=%d failed: %s", S, M, e)
-                continue
-            stage_devs = [tuple(range(s * per, (s + 1) * per))
-                          for s in range(S)]
-            # Stage x spmd nesting (reference: up to 3 split ordinals incl.
-            # the stage level, auto_parallel.cc:132-181): each tp variant
-            # re-prices the SAME stage cut with per-stage compute divided
-            # over the model axis plus the stage planner's TP comm, folded
-            # into the task-time model as equivalent flops.
-            stage_graphs = None
-            for tp in (1, 2, 4, 8):
-                if tp > per or per % tp:
-                    continue
-                try:
-                    dag, _ = build_pipeline_task_dag(prog, stage_devs)
-                    if tp > 1:
-                        if stage_graphs is None:
-                            stage_graphs = _stage_fwd_graphs(prog)
-                        comm_s = _stage_tp_comm_seconds(stage_graphs, tp)
-                        from tepdist_tpu.parallel.performance_utils import (
-                            PerfUtils,
-                            chip_spec,
-                        )
-                        from tepdist_tpu.runtime.task_graph import TaskType
-                        sec_per_flop = PerfUtils.compute_time(
-                            1.0, chip_spec())
-                        for n in dag.nodes:
-                            if n.task_type == TaskType.COMPUTE:
-                                n.flops = (n.flops / tp
-                                           + comm_s[n.stage] / sec_per_flop)
-                    cost = Evaluator(
-                        MeshTopology([("stage", S)])).run_pipeline(dag)
-                    candidates.append(
-                        {"kind": "pipeline", "num_stages": S,
-                         "num_micro_batches": M, "intra_tp": tp,
-                         "cost": cost})
-                except Exception as e:  # noqa: BLE001
-                    log.info("pipeline proposal S=%d M=%d tp=%d failed: %s",
-                             S, M, tp, e)
-    if not candidates:
-        raise RuntimeError("no feasible parallelism proposal")
-    best = min(candidates, key=lambda c: c["cost"].key())
-    log.info("exploration winner: %s (duration %.3e s/step) of %d proposals",
-             best["kind"], best["cost"].total_duration, len(candidates))
-    if ServiceEnv.get().debug:
-        _dump_candidate_table(candidates, best)
-    best["candidates"] = candidates
-    return best
-
-
-def _stage_fwd_graphs(prog) -> List[Any]:
-    """Trace each stage's forward jaxpr ONCE (tp-independent; reused
-    across the tp variants of a proposal)."""
-    from tepdist_tpu.graph.jaxpr_graph import trace_graph
-
-    fwd_fns = prog.decomp.forward_fns()
-    graphs = []
-    for s in range(prog.num_stages):
-        mod = prog.stages[s]
-        sds = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
-               for v in mod.invars]
-        graphs.append(trace_graph(fwd_fns[s], *sds)[0])
-    return graphs
-
-
-def _stage_tp_comm_seconds(stage_graphs, tp: int) -> List[float]:
-    """Per-stage FORWARD TP comm time (seconds) under a ``model`` axis of
-    size ``tp``: the stage planner's comm-only objective. NOT doubled for
-    the backward — the caller adds it to both the fwd and the bwd COMPUTE
-    node of each (stage, micro), which prices the reverse collectives
-    (that mirror the forward's) exactly once."""
-    from tepdist_tpu.parallel.cost_spmd_strategy import CostSpmdStrategy
-
-    return [(CostSpmdStrategy(g, "model", tp, fixed={}).run().comm_cost
-             or 0.0) for g in stage_graphs]
-
-
-def _dump_candidate_table(candidates, best) -> None:
-    """DEBUG: ranked per-candidate cost table on disk (reference: candidate
-    strategy dumps, auto_parallel.cc:309-311)."""
-    from tepdist_tpu.core.debug_dump import write_dump
-
-    ranked = sorted(candidates, key=lambda c: c["cost"].key())
-    lines = [f"{'rank':>4} {'kind':>8} {'config':<28} "
-             f"{'duration_s':>12} {'coll%':>6} {'bubble%':>8}"]
-    for r, c in enumerate(ranked):
-        cfg = (str(c["topology"]) if c["kind"] == "spmd" else
-               f"S={c['num_stages']} M={c['num_micro_batches']}"
-               + (f" tp={c['intra_tp']}" if c.get("intra_tp", 1) > 1
-                  else ""))
-        cost = c["cost"]
-        mark = " <== winner" if c is best else ""
-        lines.append(f"{r:>4} {c['kind']:>8} {cfg:<28} "
-                     f"{cost.total_duration:>12.4e} "
-                     f"{100 * cost.coll_ratio:>6.1f} "
-                     f"{100 * cost.bubble_ratio:>8.1f}{mark}")
-    write_dump("exploration_candidates.txt", "\n".join(lines) + "\n")
+    return explore(loss_fn, params, *example_batch, n_devices=n_devices,
+                   num_micro_batches=num_micro_batches)
 
 
 def plan_training(

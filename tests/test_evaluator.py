@@ -455,7 +455,7 @@ def test_pipeline_cost_reports_coll_and_dcn():
 def test_exploration_candidate_table_dump(tmp_path, monkeypatch):
     """DEBUG exploration leaves a ranked candidate table on disk
     (reference: per-candidate cost dumps, auto_parallel.cc:309-311)."""
-    from tepdist_tpu.train import _dump_candidate_table
+    from tepdist_tpu.parallel.exploration import _dump_candidate_table
 
     monkeypatch.setenv("TEPDIST_DUMP_DIR", str(tmp_path))
     mk = lambda d: Cost(total_duration=d, compute_efficiency=0.5,

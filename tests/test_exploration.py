@@ -1,0 +1,131 @@
+"""Unified exploration surface (VERDICT r4 #8): every entry point —
+``train.plan_training(explore=True)``, library-level
+``auto_parallel_explore``, and the service's explore mode — searches the
+SAME candidate space (SPMD meshes + seq-parallel meshes + pipeline stage
+cuts), via parallel/exploration.py.
+
+Reference parity: AutoParallel::RunExplorationlMode proposals include
+pipeline levels (reference: service/parallel/auto_parallel.cc:132-181,236).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tepdist_tpu.core.service_env import ServiceEnv
+from tepdist_tpu.models import gpt2
+from tepdist_tpu.parallel.auto_parallel import (
+    ParallelPlan,
+    auto_parallel_explore,
+)
+from tepdist_tpu.parallel.exploration import PipelineWinner, explore
+
+
+def _deep_mlp(depth, width, batch, concrete=False):
+    def loss(params, x, y):
+        h = x
+        for i in range(depth):
+            h = jax.nn.relu(h @ params[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    if concrete:
+        k = jax.random.PRNGKey(0)
+        params = {f"w{i}": jax.random.normal(
+            jax.random.fold_in(k, i), (width, width)) * 0.05
+            for i in range(depth)}
+        x = jax.random.normal(k, (batch, width))
+        y = jnp.zeros((batch, width))
+    else:
+        params = {f"w{i}": jax.ShapeDtypeStruct((width, width), jnp.float32)
+                  for i in range(depth)}
+        x = jax.ShapeDtypeStruct((batch, width), jnp.float32)
+        y = jax.ShapeDtypeStruct((batch, width), jnp.float32)
+    return loss, params, x, y
+
+
+def test_library_explore_seq_plan_for_long_context(devices):
+    """auto_parallel_explore on a long-T attention loss returns a LOWERED
+    plan whose topology carries a seq axis (the candidate is materialized
+    through the ring/Ulysses motif rewrite, not just priced)."""
+    cfg = dataclasses.replace(gpt2.CONFIGS["test"], n_ctx=32768, n_head=2)
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    toks = gpt2.fake_batch(cfg, 2, 32768)
+    plan = auto_parallel_explore(
+        lambda p, t: gpt2.loss_fn(p, t, cfg), 8, params, toks)
+    assert isinstance(plan, ParallelPlan)
+    assert plan.mode == "exploration"
+    assert any(n == "seq" and s > 1 for n, s in plan.topology.device_axes()), \
+        plan.topology
+    # Seq candidates competed in the same argmin as the mesh proposals
+    # (pipeline cuts are skipped at batch 2: indivisible by any M).
+    seq_cands = [c for c in plan.candidates
+                 if c["kind"] == "spmd"
+                 and any(n == "seq" for n, _ in c["topology"].device_axes())]
+    assert seq_cands
+
+
+def test_library_explore_pipeline_for_deep_skinny_model():
+    """In the comm-dominated regime (slow interconnect emulating DCN-bound
+    multi-host, replication memory-infeasible) a deep skinny stack's best
+    plan is a pipeline stage cut — and the library surface RETURNS it
+    (VERDICT r4 #3: callers must not silently lose PP candidates)."""
+    loss, params, x, y = _deep_mlp(24, 16384, 8)
+    try:
+        ServiceEnv.reset({"ICI_BANDWIDTH": 0.05, "COMM_OVERLAP": 0.0})
+        winner = auto_parallel_explore(loss, 8, params, x, y,
+                                       num_micro_batches=4)
+    finally:
+        ServiceEnv.reset()
+    assert isinstance(winner, PipelineWinner), type(winner)
+    assert winner.num_stages >= 2
+    assert winner.cost.memory_feasible
+    kinds = {c["kind"] for c in winner.candidates}
+    assert kinds == {"spmd", "pipeline"}
+
+
+def test_pipeline_winner_build_executes(devices):
+    """PipelineWinner.build materializes a runnable task-graph executable
+    whose training trajectory matches the unsharded reference."""
+    loss, params, x, y = _deep_mlp(4, 32, 8, concrete=True)
+    winner = PipelineWinner(
+        num_stages=2, num_micro_batches=2, intra_tp=1, cost=None,
+        candidates=[], loss_fn=loss, params=params, example_batch=(x, y))
+    exe = winner.build(optax.sgd(0.1), devices=devices[:2])
+    exe.load_variables(params)
+    losses = [exe.step(x, y) for _ in range(3)]
+    assert losses[-1] < losses[0]
+
+    # Unsharded reference trajectory.
+    tx = optax.sgd(0.1)
+    p = params
+    s = tx.init(p)
+    ref = []
+    for _ in range(3):
+        l, g = jax.value_and_grad(loss)(p, x, y)
+        u, s = tx.update(g, s, p)
+        p = optax.apply_updates(p, u)
+        ref.append(float(l))
+    np.testing.assert_allclose(losses, ref, rtol=1e-4)
+
+
+def test_explore_and_train_share_candidate_space():
+    """train.explore_parallelism IS the unified explorer (same module, same
+    candidates) — no entry point searches a private space."""
+    from tepdist_tpu import train
+
+    loss, params, x, y = _deep_mlp(4, 64, 8, concrete=True)
+    a = train.explore_parallelism(loss, params, x, y, n_devices=8,
+                                  num_micro_batches=2)
+    b = explore(loss, params, x, y, n_devices=8, num_micro_batches=2)
+    ka = sorted((c["kind"], str(c.get("topology", "")),
+                 c.get("num_stages", 0), c.get("num_micro_batches", 0),
+                 c.get("intra_tp", 0)) for c in a["candidates"])
+    kb = sorted((c["kind"], str(c.get("topology", "")),
+                 c.get("num_stages", 0), c.get("num_micro_batches", 0),
+                 c.get("intra_tp", 0)) for c in b["candidates"])
+    assert ka == kb
+    assert a["kind"] == b["kind"]
