@@ -434,7 +434,6 @@ def auto_parallel_explore(
 
     SPMD/seq winners come back as a lowered :class:`ParallelPlan` with
     ``.cost`` and ``.candidates`` attached."""
-    from tepdist_tpu.parallel.evaluator import Evaluator  # noqa: F401
     from tepdist_tpu.parallel.exploration import (
         PipelineWinner,
         pipeline_candidates,
@@ -448,22 +447,51 @@ def auto_parallel_explore(
     scalar_loss = (not example_kwargs and len(graph.outvars) == 1
                    and graph.outvars[0].aval.shape == ()
                    and len(example_args) >= 2)
-    candidates = spmd_candidates(graph, num_devices, annotations,
+    # Price on the TRUE step graph: for a scalar loss the executed step is
+    # grad(fn), and the pipeline/seq candidates already price fwd+bwd —
+    # ranking SPMD candidates on the forward-only graph would bias the
+    # argmin toward SPMD (its compute would omit the backward ~2/3 and
+    # every gradient reduce).
+    if scalar_loss:
+        price_graph, _, _ = trace_graph(jax.value_and_grad(fn),
+                                        *example_args)
+    else:
+        price_graph = graph
+    candidates = spmd_candidates(price_graph, num_devices, annotations,
                                  num_micro_batches)
     if scalar_loss:
         params, *batch = example_args
         batch_rows = jax.tree_util.tree_leaves(batch)[0].shape[0]
-        candidates += seq_candidates(graph, num_devices, batch_rows)
+        candidates += seq_candidates(price_graph, num_devices, batch_rows)
         candidates += pipeline_candidates(
             fn, params, tuple(batch), num_devices, batch_rows,
             num_micro_batches if num_micro_batches > 1 else 4)
     if not candidates:
         raise RuntimeError("no feasible topology proposal")
-    best = min(candidates, key=lambda c: c["cost"].key())
-    log.info("exploration winner: %s (duration %.3e s/step) of %d "
-             "proposals", best["kind"], best["cost"].total_duration,
-             len(candidates))
 
+    for best in sorted(candidates, key=lambda c: c["cost"].key()):
+        try:
+            plan = _materialize_explored(
+                best, fn, graph, in_tree, out_tree, example_args,
+                example_kwargs, annotations, state_alias, devices,
+                price_graph is graph, _Xform, PipelineWinner, candidates)
+        except Exception as e:  # noqa: BLE001 — fall to the runner-up
+            log.warning("winner %s failed to materialize (%s); trying "
+                        "the runner-up", best.get("topology", best["kind"]),
+                        e)
+            continue
+        log.info("exploration winner: %s (duration %.3e s/step) of %d "
+                 "proposals", best["kind"], best["cost"].total_duration,
+                 len(candidates))
+        return plan
+    raise RuntimeError("no proposal could be materialized")
+
+
+def _materialize_explored(best, fn, graph, in_tree, out_tree, example_args,
+                          example_kwargs, annotations, state_alias, devices,
+                          priced_on_fn_graph, _Xform, PipelineWinner,
+                          candidates):
+    """Lower one explored candidate into its executable plan form."""
     if best["kind"] == "pipeline":
         params, *batch = example_args
         return PipelineWinner(
@@ -474,36 +502,41 @@ def auto_parallel_explore(
             loss_fn=fn, params=params, example_batch=tuple(batch))
 
     topo = best["topology"]
-    strategies = best.get("strategies")
-    if strategies is None or any(n == "seq" and s > 1
-                                 for n, s in topo.device_axes()):
-        if any(n == "seq" and s > 1 for n, s in topo.device_axes()):
-            # Materialize the seq winner: rewrite the attention motifs to
-            # the priced ring/Ulysses algorithm BEFORE planning, so the
-            # sequence dim stays sharded through the rewritten collective
-            # (the same lowering plan_training applies).
-            from tepdist_tpu.parallel.attention_motif import (
-                best_seq_comm,
-                build_ring_rewritten,
-                detect_motifs,
-            )
+    is_seq = any(n == "seq" and s > 1 for n, s in topo.device_axes())
+    # Candidate strategies were planned on the PRICING graph; when that is
+    # the fn graph itself (non-scalar fn) they can be reused directly.
+    strategies = best.get("strategies") if priced_on_fn_graph else None
+    if is_seq:
+        # Materialize the seq winner: rewrite the attention motifs to the
+        # priced ring/Ulysses algorithm BEFORE planning, so the sequence
+        # dim stays sharded through the rewritten collective (the same
+        # lowering plan_training applies). Strict motif detection — an
+        # escaping motif was priceable but is not rewritable, and the
+        # caller loop falls back to the runner-up candidate.
+        from tepdist_tpu.parallel.attention_motif import (
+            best_seq_comm,
+            build_ring_rewritten,
+            detect_motifs,
+        )
 
-            motifs = detect_motifs(graph)
-            if not motifs:
-                raise RuntimeError("seq winner but no rewritable motif")
-            seq_size = dict(topo.device_axes())["seq"]
-            impl, _ = best_seq_comm(motifs, seq_size, with_backward=True)
-            for m in motifs:
-                m.impl = impl
-            mesh = topo.to_jax_mesh(
-                list(devices if devices is not None else jax.devices()))
-            rw = build_ring_rewritten(graph, motifs, mesh, "seq")
+        motifs = detect_motifs(graph)
+        if not motifs:
+            raise RuntimeError("no rewritable attention motif")
+        seq_size = dict(topo.device_axes())["seq"]
+        impl, _ = best_seq_comm(motifs, seq_size, with_backward=True)
+        for m in motifs:
+            m.impl = impl
+        mesh = topo.to_jax_mesh(
+            list(devices if devices is not None else jax.devices()))
+        rw = build_ring_rewritten(graph, motifs, mesh, "seq")
 
-            def fn_rw(*args, _rw=rw):
-                flat, _ = jax.tree_util.tree_flatten((args, {}))
-                return _rw(*flat)[0]
+        def fn_rw(*args, _rw=rw):
+            flat, _ = jax.tree_util.tree_flatten((args, {}))
+            return _rw(*flat)[0]
 
-            graph, in_tree, out_tree = trace_graph(fn_rw, *example_args)
+        graph, in_tree, out_tree = trace_graph(fn_rw, *example_args)
+        strategies = None
+    if strategies is None:
         strategies = plan_axes(graph, topo, annotations, "cost")
     xform = _Xform(graph, topo)
     sharding_plan = xform.lower(strategies, state_alias=state_alias)
