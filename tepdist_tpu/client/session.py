@@ -49,7 +49,8 @@ class TepdistSession:
                            *example_batch,
                            annotations: Optional[dict] = None,
                            init_specs: Optional[dict] = None,
-                           init_seed: int = 0) -> Dict:
+                           init_seed: int = 0,
+                           _explore_extras: Optional[dict] = None) -> Dict:
         """Trace + ship the whole training step; transfer initial state.
 
         ``step_fn(params, opt_state, *batch) -> (loss, params, opt_state)``.
@@ -108,6 +109,7 @@ class TepdistSession:
             annotations=ann_wire,
             init_specs=init_specs or None,
             init_seed=init_seed,
+            **(_explore_extras or {}),
         )
         self.handle = resp["handle"]
 
@@ -126,11 +128,22 @@ class TepdistSession:
     def compile_training(self, loss_fn, optimizer, params, *example_batch,
                          num_micro_batches: int = 1,
                          annotations=None, init_specs=None,
-                         init_seed: int = 0):
+                         init_seed: int = 0,
+                         optimizer_spec: Optional[dict] = None,
+                         explore: Optional[bool] = None):
         """Remote counterpart of ``plan_training``: give a loss function
         and an optax optimizer; the full training step (gradients + GA scan
         + optimizer apply) is composed client-side, traced, and shipped —
-        the server plans/compiles/executes it and holds all state."""
+        the server plans/compiles/executes it and holds all state.
+
+        FULLY AUTOMATIC planning (reference: the service's exploration
+        mode, auto_parallel.cc:236): when the session has NO mesh_axes
+        (and mode is not "rule"), the loss jaxpr rides along and the
+        SERVER explores SPMD meshes, seq meshes, and pipeline stage cuts,
+        compiling the Evaluator-minimal winner. Pass ``optimizer_spec``
+        (tepdist_tpu.optim.optimizer_spec) so the server can materialize
+        pipeline/seq winners (those re-compose the step server-side; an
+        opaque optax object cannot travel). ``explore=False`` opts out."""
         import optax
 
         from tepdist_tpu.parallel.sync_free import build_ga_step
@@ -149,10 +162,35 @@ class TepdistSession:
         opt_state = (optimizer.init(params)
                      if not _is_abstract(params)
                      else jax.eval_shape(optimizer.init, params))
+        if explore is None:
+            explore = not self.mesh_axes and self.mode != "rule"
+        extras = None
+        if explore:
+            loss_closed = jax.make_jaxpr(loss_fn)(params, *example_batch)
+            extras = {
+                "explore": True,
+                "loss_module": serialize_closed_jaxpr(loss_closed),
+                "n_param_leaves": len(jax.tree_util.tree_leaves(params)),
+                "optimizer_spec": optimizer_spec,
+                "num_micro_batches": num_micro_batches,
+            }
+            b0 = jax.tree_util.tree_leaves(example_batch)[0]
+            if num_micro_batches > 1 and b0.shape[0] % num_micro_batches == 0:
+                # Micro-shape loss trace for the server's pipeline
+                # proposals (jaxpr constants bake the trace shape —
+                # plan_pipeline's micro-trace contract, same helper).
+                from tepdist_tpu.parallel.pipeline import (
+                    micro_abstract_batch,
+                )
+
+                micro_batch = micro_abstract_batch(example_batch,
+                                                   num_micro_batches)
+                extras["micro_loss_module"] = serialize_closed_jaxpr(
+                    jax.make_jaxpr(loss_fn)(params, *micro_batch))
         return self.compile_train_step(
             step_fn, params, opt_state, *example_batch,
             annotations=annotations, init_specs=init_specs,
-            init_seed=init_seed)
+            init_seed=init_seed, _explore_extras=extras)
 
     # ------------------------------------------------------------------
     def run(self, *batch) -> float:

@@ -75,6 +75,9 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
     ("TPU_GENERATION", str, "v5e", "[tpu] chip generation for the cost model"),
     ("ICI_BANDWIDTH", float, -1.0, "[tpu] override ICI GB/s per link"),
     ("DCN_BANDWIDTH", float, -1.0, "[tpu] override DCN GB/s per host"),
+    ("HBM_GB", float, -1.0, "[tpu] override per-device HBM GB for the cost "
+     "model (reference: the MEMORY per-device byte default, "
+     "evaluator.h:53)"),
     ("REMAT_POLICY", str, "none", "[tpu] jax.checkpoint policy for stages"),
     ("DONATE_ARGS", bool, True, "[tpu] donate variable buffers into the step"),
 ]

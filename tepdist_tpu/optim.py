@@ -78,3 +78,41 @@ def adamw_bf16(learning_rate: float, b1: float = 0.9, b2: float = 0.95,
         optax.add_decayed_weights(weight_decay, mask=mask),
         optax.scale(-learning_rate),
     )
+
+
+# ----------------------------------------------------------------------
+# Declarative optimizer specs (the wire form of an optimizer)
+# ----------------------------------------------------------------------
+#
+# The RPC service's fully-automatic explore mode (reference:
+# RunExplorationlMode invoked from BuildExecutionPlan,
+# service/parallel/auto_parallel.cc:236 + service_rt.cc:218-308) may pick
+# a PIPELINE stage cut, which the server materializes by composing
+# per-stage optimizer applies itself — so the client ships the optimizer
+# declaratively (name + hyperparams) instead of as opaque traced jaxprs
+# (a whole-model update jaxpr cannot be re-cut per stage).
+
+_OPTIMIZERS = {
+    "sgd": optax.sgd,
+    "adam": optax.adam,
+    "adamw": optax.adamw,
+    "adamw_bf16": adamw_bf16,
+}
+
+
+def optimizer_spec(name: str, **kwargs) -> dict:
+    """Build a wire-serializable optimizer spec; validates the name."""
+    if name not in _OPTIMIZERS:
+        raise KeyError(f"unknown optimizer {name!r}; "
+                       f"known: {sorted(_OPTIMIZERS)}")
+    return {"name": name, **kwargs}
+
+
+def make_optimizer(spec: dict):
+    """Reconstruct the optax transform from its wire spec."""
+    spec = dict(spec)
+    name = spec.pop("name")
+    if name not in _OPTIMIZERS:
+        raise KeyError(f"unknown optimizer {name!r}; "
+                       f"known: {sorted(_OPTIMIZERS)}")
+    return _OPTIMIZERS[name](**spec)

@@ -525,3 +525,37 @@ def build_ring_rewritten(graph: JaxprGraph, motifs: List[AttentionMotif],
         return tuple(read(a) for a in jaxpr.outvars)
 
     return run
+
+
+def seq_rewritten_loss(loss_fn, seq_size: int, mesh, *example_args,
+                       impl: Optional[str] = None):
+    """Rewrite ``loss_fn``'s attention motifs to the priced ring/Ulysses
+    algorithm for a ``seq`` axis of ``seq_size`` — the ONE seq-lowering
+    composition shared by plan_training, the library explorer, and the
+    RPC service's explore mode (SURVEY §5.7; the rewrite runs BEFORE
+    differentiation so value_and_grad traces the reverse ring and the
+    sequence dim stays sharded in both directions).
+
+    Returns ``(rewritten_fn, impl)`` where ``rewritten_fn`` takes the same
+    positional args as ``loss_fn``. Raises ValueError when no closed
+    motif is rewritable (escaping motifs are priceable, not lowerable)."""
+    import jax as _jax
+
+    from tepdist_tpu.graph.jaxpr_graph import trace_graph
+
+    g_loss, _, _ = trace_graph(loss_fn, *example_args)
+    motifs = detect_motifs(g_loss)
+    if not motifs:
+        raise ValueError("topology has a 'seq' axis but the loss has "
+                         "no rewritable attention motif")
+    if impl is None:
+        impl, _ = best_seq_comm(motifs, seq_size, with_backward=True)
+    for m in motifs:
+        m.impl = impl
+    rw = build_ring_rewritten(g_loss, motifs, mesh, "seq")
+
+    def rewritten(*args, _rw=rw):
+        flat, _ = _jax.tree_util.tree_flatten((args, {}))
+        return _rw(*flat)[0]
+
+    return rewritten, impl

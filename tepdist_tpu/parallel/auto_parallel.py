@@ -513,27 +513,13 @@ def _materialize_explored(best, fn, graph, in_tree, out_tree, example_args,
         # lowering plan_training applies). Strict motif detection — an
         # escaping motif was priceable but is not rewritable, and the
         # caller loop falls back to the runner-up candidate.
-        from tepdist_tpu.parallel.attention_motif import (
-            best_seq_comm,
-            build_ring_rewritten,
-            detect_motifs,
-        )
+        from tepdist_tpu.parallel.attention_motif import seq_rewritten_loss
 
-        motifs = detect_motifs(graph)
-        if not motifs:
-            raise RuntimeError("no rewritable attention motif")
         seq_size = dict(topo.device_axes())["seq"]
-        impl, _ = best_seq_comm(motifs, seq_size, with_backward=True)
-        for m in motifs:
-            m.impl = impl
         mesh = topo.to_jax_mesh(
             list(devices if devices is not None else jax.devices()))
-        rw = build_ring_rewritten(graph, motifs, mesh, "seq")
-
-        def fn_rw(*args, _rw=rw):
-            flat, _ = jax.tree_util.tree_flatten((args, {}))
-            return _rw(*flat)[0]
-
+        fn_rw, _impl = seq_rewritten_loss(fn, seq_size, mesh,
+                                          *example_args)
         graph, in_tree, out_tree = trace_graph(fn_rw, *example_args)
         strategies = None
     if strategies is None:

@@ -165,13 +165,19 @@ def seq_candidates(graph, n_devices: int,
 
 def pipeline_candidates(loss_fn: Callable, params, example_batch,
                         n_devices: int, batch_rows: int,
-                        num_micro_batches: int = 4
-                        ) -> List[Dict[str, Any]]:
+                        num_micro_batches: int = 4,
+                        micro_options=None) -> List[Dict[str, Any]]:
     """Pipeline stage-cut proposals S x M x intra-stage-TP (reference: up
     to 3 split ordinals incl. the stage level, auto_parallel.cc:132-181):
     each tp variant re-prices the SAME stage cut with per-stage compute
     divided over the model axis plus the stage planner's TP comm, folded
-    into the task-time model as equivalent flops."""
+    into the task-time model as equivalent flops.
+
+    ``micro_options``: explicit M proposals. The RPC service passes the
+    client's [M] — its loss arrives as a jaxpr whose shape-dependent
+    constants (mean denominators) were baked at batch/M, so only that
+    micro size evaluates correctly (plan_pipeline's micro-shape trace
+    contract)."""
     from tepdist_tpu.parallel.evaluator import Evaluator
     from tepdist_tpu.parallel.pipeline import plan_pipeline
     from tepdist_tpu.runtime.execution_plan import build_pipeline_task_dag
@@ -181,7 +187,8 @@ def pipeline_candidates(loss_fn: Callable, params, example_batch,
         if S > n_devices or n_devices % S:
             continue
         per = n_devices // S
-        for M in {num_micro_batches, 2 * num_micro_batches}:
+        for M in (micro_options if micro_options is not None
+                  else {num_micro_batches, 2 * num_micro_batches}):
             if batch_rows % M:
                 continue
             try:
@@ -263,6 +270,8 @@ def explore(
     num_micro_batches: int = 4,
     include_pipeline: bool = True,
     include_seq: bool = True,
+    pipeline_loss_fn: Callable = None,
+    pipeline_micro_options=None,
 ) -> Dict[str, Any]:
     """Full exploration over the unified candidate space (reference:
     RunExplorationlMode over DeviceSplitPlan proposals incl. pipeline
@@ -289,8 +298,9 @@ def explore(
         excluded.append("seq")
     if include_pipeline:
         candidates += pipeline_candidates(
-            loss_fn, params, example_batch, n_devices, batch_rows,
-            num_micro_batches)
+            pipeline_loss_fn or loss_fn, params, example_batch, n_devices,
+            batch_rows, num_micro_batches,
+            micro_options=pipeline_micro_options)
     else:
         excluded.append("pipeline")
     if not candidates:

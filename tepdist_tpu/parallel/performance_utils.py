@@ -49,13 +49,14 @@ def chip_spec(generation: str | None = None) -> TpuChipSpec:
     if spec is None:
         raise KeyError(f"unknown TPU generation {gen!r}; known: {list(TPU_CHIPS)}")
     env = ServiceEnv.get()
-    if env.ici_bandwidth > 0 or env.dcn_bandwidth > 0:
+    if env.ici_bandwidth > 0 or env.dcn_bandwidth > 0 or env.hbm_gb > 0:
         spec = dataclasses.replace(
             spec,
             ici_gbps_per_link=(env.ici_bandwidth if env.ici_bandwidth > 0
                                else spec.ici_gbps_per_link),
             dcn_gbps=(env.dcn_bandwidth if env.dcn_bandwidth > 0
                       else spec.dcn_gbps),
+            hbm_gb=(env.hbm_gb if env.hbm_gb > 0 else spec.hbm_gb),
         )
     return spec
 

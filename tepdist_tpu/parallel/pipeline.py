@@ -160,6 +160,22 @@ class PipelineProgram:
         return step
 
 
+def micro_abstract_batch(batch, num_micro_batches: int, batch_dim: int = 0):
+    """Batch pytrees shrunk to MICRO-batch shapes (divide the batch dim by
+    M where divisible) — THE micro-shape trace contract: plan_pipeline
+    traces stage modules at these shapes, and the RPC client ships its
+    micro loss jaxpr traced at exactly these shapes (jaxpr constants like
+    mean denominators bake the trace shape)."""
+
+    def micro(leaf):
+        shape = list(leaf.shape)
+        if shape and shape[batch_dim] % num_micro_batches == 0:
+            shape[batch_dim] //= num_micro_batches
+        return jax.ShapeDtypeStruct(tuple(shape), leaf.dtype)
+
+    return tuple(jax.tree_util.tree_map(micro, b) for b in batch)
+
+
 def plan_pipeline(
     loss_fn: Callable,
     num_stages: int,
@@ -176,14 +192,7 @@ def plan_pipeline(
     over micro-batch shapes), so baked constants like mean denominators are
     correct per micro batch."""
 
-    def micro_abstract(leaf):
-        shape = list(leaf.shape)
-        if shape and shape[batch_dim] % num_micro_batches == 0:
-            shape[batch_dim] //= num_micro_batches
-        return jax.ShapeDtypeStruct(tuple(shape), leaf.dtype)
-
-    micro_batch = tuple(
-        jax.tree_util.tree_map(micro_abstract, b) for b in batch)
+    micro_batch = micro_abstract_batch(batch, num_micro_batches, batch_dim)
     graph, in_tree, _ = trace_graph(loss_fn, params, *micro_batch)
     sketch = GraphSketch(graph)
     assignment = sketch.stage_plan(num_stages)

@@ -76,7 +76,20 @@ class TepdistClient:
         share_dev_flags: Optional[Sequence[bool]] = None,
         init_specs: Optional[Dict[int, dict]] = None,
         init_seed: int = 0,
+        loss_module: Optional[bytes] = None,
+        micro_loss_module: Optional[bytes] = None,
+        n_param_leaves: Optional[int] = None,
+        optimizer_spec: Optional[dict] = None,
+        num_micro_batches: int = 1,
+        explore: bool = False,
     ) -> Dict[str, Any]:
+        """``explore=True`` + ``loss_module`` (the serialized loss jaxpr)
+        asks the SERVER to run the full parallelism exploration — SPMD
+        meshes, seq meshes, pipeline stage cuts — and compile the winner
+        (reference: RunExplorationlMode inside BuildExecutionPlan,
+        auto_parallel.cc:236 + service_rt.cc:218-308). ``optimizer_spec``
+        (see tepdist_tpu.optim.optimizer_spec) lets the server materialize
+        pipeline/seq winners by composing the step itself."""
         options = {
             "mesh_axes": [[a, n] for a, n in mesh_axes] or None,
             "variable_indices": list(variable_indices),
@@ -89,9 +102,24 @@ class TepdistClient:
                            if init_specs else None),
             "init_seed": init_seed,
         }
+        blobs = [module_bytes]
+        if explore:
+            options["explore"] = True
+            options["optimizer_spec"] = optimizer_spec
+            options["num_micro_batches"] = num_micro_batches
+            if loss_module is not None:
+                options["loss_module_blob"] = len(blobs)
+                options["n_param_leaves"] = int(n_param_leaves)
+                blobs.append(loss_module)
+            if micro_loss_module is not None:
+                # The loss re-traced at MICRO-batch shapes: jaxpr
+                # constants (mean denominators) bake the trace shape, so
+                # the server's pipeline stage modules must come from a
+                # trace at batch/M, not a re-eval of the full-batch jaxpr.
+                options["micro_loss_module_blob"] = len(blobs)
+                blobs.append(micro_loss_module)
         resp = self.stub.call("BuildExecutionPlan",
-                              protocol.pack({"options": options},
-                                            [module_bytes]))
+                              protocol.pack({"options": options}, blobs))
         header, _ = protocol.unpack(resp)
         return header
 

@@ -63,6 +63,8 @@ class ExecutionPlanCache:
 class _CompiledPlan:
     """Server-side compiled plan + its argument routing metadata."""
 
+    kind = "spmd"
+
     def __init__(self, step_fn, in_specs, topology, var_arg_indices,
                  state_alias, out_is_state, n_invars, strategies_summary,
                  shardings=None):
@@ -75,6 +77,68 @@ class _CompiledPlan:
         self.out_is_state = out_is_state
         self.n_invars = n_invars
         self.strategies_summary = strategies_summary
+
+
+class _CompiledPipelinePlan:
+    """A pipeline-winner plan from the service's explore mode: the
+    task-graph runtime executable, server-held per-stage state (reference:
+    the PIPELINE par type executing through the virtual-client task
+    machinery rather than one SPMD module, service_rt.cc:218-308).
+
+    State contract with the servicer's variable store: global indices
+    0..n_params-1 are the parameter leaves, n_params..n_state-1 the
+    optimizer-state leaves (the SAME layout the SPMD plans use), loaded
+    into the executable lazily on first step / after a restore, and synced
+    back on fetch/save."""
+
+    kind = "pipeline"
+
+    def __init__(self, exe, optimizer, n_params, n_state, n_invars,
+                 strategies_summary):
+        self.exe = exe
+        self.optimizer = optimizer
+        self.n_params = n_params
+        self.n_state = n_state
+        self.n_invars = n_invars          # n_state + batch leaves
+        self.var_arg_indices = set(range(n_state))
+        self.state_alias = {}             # state lives in the executable
+        self.out_is_state = {}
+        self.strategies_summary = strategies_summary
+        self.shardings = None
+        self.loaded = False
+
+    def load_from_store(self, variables, with_opt_state: bool):
+        """Pull params (and optionally optimizer slots) from the servicer's
+        variable store into the per-stage runtime."""
+        import jax as _jax
+
+        missing = [i for i in range(self.n_params) if i not in variables]
+        if missing:
+            raise KeyError(
+                f"pipeline plan: parameter leaves {missing} neither "
+                "transferred nor initialized")
+        params = [variables[i] for i in range(self.n_params)]
+        self.exe.load_variables(params)   # re-inits per-stage opt states
+        if with_opt_state:
+            opt_sds = _jax.eval_shape(self.optimizer.init, params)
+            tree = _jax.tree_util.tree_structure(opt_sds)
+            leaves = [variables[i]
+                      for i in range(self.n_params, self.n_state)]
+            self.exe.load_opt_state(
+                _jax.tree_util.tree_unflatten(tree, leaves))
+        self.loaded = True
+
+    def sync_to_store(self, variables):
+        """Write the runtime's current state back into the variable store
+        (FetchResourceVars / checkpoint reads go through the store)."""
+        import jax as _jax
+
+        if not self.loaded:
+            return
+        flat = list(_jax.tree_util.tree_leaves(self.exe.fetch_variables()))
+        flat += list(_jax.tree_util.tree_leaves(self.exe.fetch_opt_state()))
+        for i, leaf in enumerate(flat):
+            variables[i] = leaf
 
 
 class TepdistServicer:
@@ -136,6 +200,29 @@ class TepdistServicer:
             for s in gone:
                 del self._parked_transfers[s]
 
+    def _sync_active_pipeline(self) -> None:
+        """Flush the live pipeline runtime's state into the variable store
+        before ANY store read (fetch / save / an SPMD plan resolving
+        variable args). Takes _exec_lock so the sync cannot observe a
+        torn mid-step state, then _lock for the store write."""
+        ap = getattr(self, "_active_pipeline", None)
+        if ap is None:
+            return
+        with self._exec_lock:
+            with self._lock:
+                ap.sync_to_store(self.variables)
+
+    def _retire_active_pipeline(self) -> None:
+        """A new plan supersedes the live pipeline runtime: flush its
+        state once (a follow-up plan — e.g. compile_generate — must see
+        the trained weights) and stop treating it as the store's source
+        of truth."""
+        ap = getattr(self, "_active_pipeline", None)
+        if ap is None:
+            return
+        self._sync_active_pipeline()
+        self._active_pipeline = None
+
     def my_cluster_ip(self) -> str:
         """This worker's peer-routable ip from the dispatched plan's
         cluster spec (loopback before any plan arrives)."""
@@ -195,10 +282,189 @@ class TepdistServicer:
         return tuple(vals) if t.bundle else vals[0]
 
     # ------------------------------------------------------------------
+    def _explore_plan(self, opts, blobs):
+        """Server-side fully-automatic planning (reference: the service
+        invokes AutoParallel's exploration itself — RunExplorationlMode
+        from BuildExecutionPlan, auto_parallel.cc:236 +
+        service_rt.cc:218-308): reconstruct the loss from its shipped
+        jaxpr, search the UNIFIED candidate space (SPMD / seq / pipeline
+        stage cuts), and return the Evaluator-minimal winner.
+
+        Returns (winner_dict, loss_fn, params_sds, batch_sds, optimizer,
+        explored_summary)."""
+        from jax.extend.core import jaxpr_as_fun
+
+        from tepdist_tpu.optim import make_optimizer
+        from tepdist_tpu.parallel.exploration import (
+            candidate_summary,
+            explore,
+        )
+
+        loss_closed = deserialize_closed_jaxpr(
+            blobs[int(opts["loss_module_blob"])])
+        n_p = int(opts["n_param_leaves"])
+        lf = jaxpr_as_fun(loss_closed)
+
+        def loss_fn(plist, *batch):
+            return lf(*plist, *batch)[0]
+
+        invars = loss_closed.jaxpr.invars
+        params_sds = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
+                      for v in invars[:n_p]]
+        batch_sds = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
+                    for v in invars[n_p:]]
+        opt_spec = opts.get("optimizer_spec")
+        optimizer = make_optimizer(opt_spec) if opt_spec else None
+        M = max(int(opts.get("num_micro_batches", 1)), 1)
+        # Pipeline proposals need the loss at MICRO-batch shapes (jaxpr
+        # constants bake the trace shape — plan_pipeline's micro-trace
+        # contract), so the service explores pipeline cuts only at the
+        # CLIENT's M, for which a micro trace was shipped (reference
+        # posture: NUM_MICRO_BATCHES is client config, service_env.h:62).
+        micro_loss_fn = None
+        if "micro_loss_module_blob" in opts:
+            mlf = jaxpr_as_fun(deserialize_closed_jaxpr(
+                blobs[int(opts["micro_loss_module_blob"])]))
+
+            def micro_loss_fn(plist, *batch):
+                return mlf(*plist, *batch)[0]
+        elif M == 1:
+            micro_loss_fn = loss_fn
+        # Pipeline/seq winners are materialized by re-composing the step
+        # SERVER-side, which needs the optimizer's update rule — without a
+        # declarative spec those kinds are excluded (recorded, not silent).
+        best = explore(
+            loss_fn, params_sds, *batch_sds,
+            n_devices=len(self.devices),
+            num_micro_batches=M,
+            include_pipeline=(optimizer is not None
+                              and micro_loss_fn is not None),
+            include_seq=optimizer is not None,
+            pipeline_loss_fn=micro_loss_fn,
+            pipeline_micro_options=[M])
+        explored = {
+            "winner": best["kind"],
+            "candidates": candidate_summary(best["candidates"], best),
+        }
+        if best.get("excluded_kinds"):
+            explored["excluded_kinds"] = best["excluded_kinds"]
+            explored["excluded_reason"] = (
+                "no optimizer_spec from client"
+                if optimizer is None else "no micro-shape loss trace")
+        best["_micro_loss_fn"] = micro_loss_fn
+        return best, loss_fn, params_sds, batch_sds, optimizer, explored
+
+    def _recompose_step(self, loss_fn, optimizer, num_micro_batches,
+                        topology, params_sds, batch_sds, n_state):
+        """Re-compose the full training step server-side (grad + GA +
+        optimizer apply; the client-side composition in
+        client/session.py:compile_training, mirrored) — used when the
+        explore winner needs a different step than the shipped one (seq
+        rewrite). Returns the traced step ClosedJaxpr."""
+        import optax
+
+        from tepdist_tpu.parallel.sync_free import build_ga_step
+
+        if topology is not None and any(
+                n == "seq" and s > 1 for n, s in topology.device_axes()):
+            from tepdist_tpu.parallel.attention_motif import (
+                seq_rewritten_loss,
+            )
+
+            seq_size = dict(topology.device_axes())["seq"]
+            loss_fn, _impl = seq_rewritten_loss(  # noqa: F811
+                loss_fn, seq_size, topology.to_jax_mesh(self.devices),
+                params_sds, *batch_sds)
+
+        def grad_fn(p, *b):
+            return jax.value_and_grad(loss_fn)(p, *b)
+
+        def apply_fn(p, s, g):
+            updates, s = optimizer.update(g, s, p)
+            return optax.apply_updates(p, updates), s
+
+        step_fn = build_ga_step(
+            grad_fn, apply_fn, num_micro_batches,
+            batch_argnums=tuple(range(1, 1 + len(batch_sds))))
+        opt_sds = jax.eval_shape(optimizer.init, params_sds)
+        n_server_state = len(params_sds) + len(
+            jax.tree_util.tree_leaves(opt_sds))
+        if n_server_state != n_state:
+            raise ValueError(
+                f"server-composed state has {n_server_state} leaves but "
+                f"the client registered {n_state} — the optimizer_spec "
+                "does not match the client's optimizer")
+        return jax.make_jaxpr(step_fn)(params_sds, opt_sds, *batch_sds)
+
+    def _build_pipeline_plan(self, opts, best, loss_fn, params_sds,
+                             batch_sds, optimizer, explored, t0) -> bytes:
+        """Materialize a pipeline explore winner as the plan behind the
+        handle: plan the stage cut, build the task-graph runtime over this
+        server's devices, and register a pipeline-kind plan (reference:
+        the PIPELINE DeviceSplitPlan compiled into per-stage def-modules +
+        task graph, service_rt.cc:218-308)."""
+        from tepdist_tpu.parallel.pipeline import plan_pipeline
+        from tepdist_tpu.runtime.executor import PipelineExecutable
+
+        S = best["num_stages"]
+        M = best["num_micro_batches"]
+        tp = best.get("intra_tp", 1)
+        opt_sds = jax.eval_shape(optimizer.init, params_sds)
+        n_params = len(params_sds)
+        n_state = n_params + len(jax.tree_util.tree_leaves(opt_sds))
+        n_state_client = len(opts.get("variable_indices", []))
+        if n_state_client and n_state != n_state_client:
+            raise ValueError(
+                f"server-composed state has {n_state} leaves but the "
+                f"client registered {n_state_client} — the optimizer_spec "
+                "does not match the client's optimizer")
+        # The micro-shape loss reconstruction: plan_pipeline traces the
+        # stage modules at exactly batch/M — the shapes this jaxpr's baked
+        # constants are correct for.
+        prog = plan_pipeline(best["_micro_loss_fn"], S, M, params_sds,
+                             *batch_sds)
+        exe = PipelineExecutable(prog, devices=self.devices,
+                                 optimizer=optimizer, intra_stage_tp=tp)
+        summary = {
+            "axes": [["stage", S]] + ([["model", tp]] if tp > 1 else []),
+            "mode": "explore",
+            "kind": "pipeline",
+            "num_stages": S,
+            "num_micro_batches": M,
+            "intra_tp": tp,
+            "planner_seconds": round(time.time() - t0, 3),
+            "explored": explored,
+        }
+        plan = _CompiledPipelinePlan(exe, optimizer, n_params, n_state,
+                                     n_state + len(batch_sds), summary)
+        handle = self.plan_cache.insert(plan)
+        # The store's state reads (FetchResourceVars / checkpoints) must
+        # see this runtime's live state once it loads.
+        self._active_pipeline = plan
+        # Server-side variable initialization works for pipeline plans too
+        # (leaves land in the store; the executable pulls them lazily).
+        init_specs = opts.get("init_specs") or {}
+        if init_specs:
+            from tepdist_tpu.runtime.initializers import init_from_spec
+            seed = int(opts.get("init_seed", 0))
+            key = jax.random.PRNGKey(seed)
+            with self._lock:
+                for idx_s, spec in init_specs.items():
+                    idx = int(idx_s)
+                    self.variables[idx] = init_from_spec(
+                        jax.random.fold_in(key, idx), spec)
+            summary["initialized_vars"] = len(init_specs)
+        log.info("BuildExecutionPlan handle=%d %s", handle, summary)
+        return protocol.pack({"handle": handle, "summary": summary})
+
     def BuildExecutionPlan(self, request: bytes, context=None) -> bytes:
         header, blobs = protocol.unpack(request)
         opts = header.get("options", {})
         t0 = time.time()
+        # A new plan supersedes any live pipeline runtime as the store's
+        # source of truth (its trained state is flushed first, so e.g. a
+        # follow-up compile_generate reads the trained weights).
+        self._retire_active_pipeline()
         closed = deserialize_closed_jaxpr(blobs[0])
 
         from tepdist_tpu.graph.jaxpr_graph import JaxprGraph
@@ -206,9 +472,33 @@ class TepdistServicer:
         from tepdist_tpu.parallel.spmd_transform import SpmdTransform
         from tepdist_tpu.core.dist_spec import DimStrategy
 
+        mode = opts.get("mode", "cost")
+        axes = opts.get("mesh_axes")
+        n_state_client = len(opts.get("variable_indices", []))
+        explored = None
+        env = ServiceEnv.get()
+        if (opts.get("explore") and not axes and mode != "rule"
+                and env.opt_level >= 1 and "loss_module_blob" in opts):
+            (best, loss_fn, params_sds, batch_sds, optimizer,
+             explored) = self._explore_plan(opts, blobs)
+            if best["kind"] == "pipeline":
+                return self._build_pipeline_plan(
+                    opts, best, loss_fn, params_sds, batch_sds, optimizer,
+                    explored, t0)
+            topology_w = best["topology"]
+            axes = [[a, n] for a, n in topology_w.device_axes()]
+            if any(n == "seq" and s > 1
+                   for n, s in topology_w.device_axes()):
+                # The shipped step traced plain attention; the seq winner
+                # executes the ring/Ulysses rewrite — re-compose the step
+                # server-side and plan THAT.
+                closed = self._recompose_step(
+                    loss_fn, optimizer,
+                    max(int(opts.get("num_micro_batches", 1)), 1),
+                    topology_w, params_sds, batch_sds, n_state_client)
+
         graph = JaxprGraph(closed, inline=False)
 
-        axes = opts.get("mesh_axes")
         if not axes:
             axes = [["data", len(self.devices)]]
         topology = MeshTopology(
@@ -221,7 +511,6 @@ class TepdistServicer:
                 int(i): {ax: DimStrategy(**d) for ax, d in spec.items()}
                 for i, spec in opts["annotations"].items()
             }
-        mode = opts.get("mode", "cost")
         strategies = plan_axes(graph, topology, annotations, mode)
         state_alias = {int(k): int(v)
                        for k, v in (opts.get("state_alias") or {}).items()}
@@ -247,6 +536,8 @@ class TepdistServicer:
             "planner_seconds": round(time.time() - t0, 3),
             "n_constraints": len(splan.constraints),
         }
+        if explored is not None:
+            summary["explored"] = explored
         from jax.sharding import NamedSharding
         shardings = [NamedSharding(mesh, spec) for spec in splan.in_specs]
         plan = _CompiledPlan(step_fn, splan.in_specs, topology, var_idx,
@@ -350,11 +641,76 @@ class TepdistServicer:
             arr.shape, sharding, lambda idx: arr[idx])
 
     # ------------------------------------------------------------------
+    def _execute_pipeline_plan(self, plan, header, blobs, t0) -> bytes:
+        """ExecutePlan for a pipeline-kind plan (service explore winner):
+        batch leaves route to the task-graph runtime; state lives in the
+        per-stage executable and syncs through the variable store on
+        fetch/save/restore."""
+        fetch = bool(header.get("fetch_resource_variables"))
+        if self.ckpt_opts.get("restore"):
+            self._do_restore(self.ckpt_opts.pop("restore"))
+        inline = {int(k): v
+                  for k, v in (header.get("inline") or {}).items()}
+        batch_vals: List[Any] = []
+        with self._lock:
+            for i in range(plan.n_state, plan.n_invars):
+                if i in inline:
+                    meta = header["inline_meta"][str(i)]
+                    val = protocol.decode_literal(meta, blobs[inline[i]])
+                elif i in self.inputs:
+                    val = self.inputs[i]
+                else:
+                    raise KeyError(
+                        f"batch arg {i} neither transferred nor inline")
+                batch_vals.append(val)
+        with self._exec_lock:
+            if not plan.loaded:
+                with self._lock:
+                    plan.load_from_store(
+                        self.variables,
+                        with_opt_state=getattr(
+                            self, "_pipeline_restored", False))
+                self._pipeline_restored = False
+            loss = plan.exe.step(*batch_vals)
+            if not header.get("inference"):
+                self.global_step += 1
+        if self.ckpt_opts.get("save"):
+            self._do_save(self.ckpt_opts.pop("save"))
+        meta, blob = protocol.encode_literal(
+            np.asarray(loss, dtype=np.float32))
+        metas, out_blobs, out_idx = [meta], [blob], [0]
+        fetched = {}
+        if fetch:
+            self._sync_active_pipeline()
+            with self._lock:
+                for ii in sorted(plan.var_arg_indices):
+                    if ii in self.variables:
+                        m, b = protocol.encode_literal(
+                            jax.device_get(self.variables[ii]))
+                        fetched[str(ii)] = {"meta": m,
+                                            "blob": len(out_blobs)}
+                        out_blobs.append(b)
+        if ServiceEnv.get().debug:
+            log.info("[ExecutePlan Duration] step=%d %.1f ms (pipeline)",
+                     self.global_step, (time.time() - t0) * 1e3)
+        return protocol.pack(
+            {"outputs": metas, "output_indices": out_idx,
+             "fetched": fetched, "global_step": self.global_step},
+            out_blobs)
+
     def ExecutePlan(self, request: bytes, context=None) -> bytes:
         t_exec0 = time.time()
         header, blobs = protocol.unpack(request)
         handle = int(header["handle"])
         plan = self.plan_cache.resolve(handle)
+        if plan.kind == "pipeline":
+            return self._execute_pipeline_plan(plan, header, blobs,
+                                               t_exec0)
+        # An SPMD plan (e.g. compile_generate) reading variables while a
+        # pipeline runtime is live must see ITS state, not the store's
+        # stale copy.
+        if plan.var_arg_indices:
+            self._sync_active_pipeline()
         fetch = bool(header.get("fetch_resource_variables"))
 
         # Consume a latched restore before stepping (reference: lazy
@@ -443,6 +799,7 @@ class TepdistServicer:
     def FetchResourceVars(self, request: bytes, context=None) -> bytes:
         header, _ = protocol.unpack(request)
         idxs = header.get("indices")
+        self._sync_active_pipeline()
         with self._lock:
             if idxs is None:
                 idxs = sorted(self.variables)
@@ -547,6 +904,7 @@ class TepdistServicer:
 
     def _do_save(self, opts) -> None:
         from tepdist_tpu.runtime.checkpoint import CheckpointUtil
+        self._sync_active_pipeline()
         with self._lock:
             # Values pass through as-is: CheckpointUtil writes only this
             # host's addressable shards for non-fully-addressable arrays
@@ -591,6 +949,12 @@ class TepdistServicer:
                     stage: [slots[j] for j in sorted(slots)]
                     for stage, slots in opt_states.items()}
             self.global_step = step
+        # A live pipeline runtime must reload the restored state (params
+        # AND optimizer slots) before its next step.
+        ap = getattr(self, "_active_pipeline", None)
+        if ap is not None:
+            ap.loaded = False
+            self._pipeline_restored = True
 
     def AbortStep(self, request: bytes, context=None) -> bytes:
         """Cancel an in-flight ExecuteRemotePlan: wake every blocked recv

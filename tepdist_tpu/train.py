@@ -228,34 +228,15 @@ def plan_training(
     # REMAT wrap: tracing inlines remat2, so wrapping must come after.
     if topology is not None and any(
             n == "seq" and s > 1 for n, s in topology.device_axes()):
-        from tepdist_tpu.graph.jaxpr_graph import trace_graph as _tg
-        from tepdist_tpu.parallel.attention_motif import (
-            build_ring_rewritten,
-            detect_motifs,
-        )
+        from tepdist_tpu.parallel.attention_motif import seq_rewritten_loss
 
-        from tepdist_tpu.parallel.attention_motif import best_seq_comm
-
-        g_loss, _, _ = _tg(loss_fn, params, *example_batch)
-        motifs = detect_motifs(g_loss)
-        if not motifs:
-            raise ValueError("topology has a 'seq' axis but the loss has "
-                             "no rewritable attention motif")
-        seq_size = dict(topology.device_axes())["seq"]
         # Lower to the PRICED winner (ring vs ulysses, fwd+bwd) — the
         # executed algorithm must match what exploration/pricing assumed.
-        impl, _cost = best_seq_comm(motifs, seq_size, with_backward=True)
-        for m in motifs:
-            m.impl = impl
-        seq_mesh = topology.to_jax_mesh(devices)
-        _rw = build_ring_rewritten(g_loss, motifs, seq_mesh, "seq")
-
-        def loss_fn(p, *b):  # noqa: F811 — deliberate rebind
-            flat, _ = jax.tree_util.tree_flatten(((p, *b), {}))
-            return _rw(*flat)[0]
-
-        log.info("seq axis: %d attention motif(s) -> %s attention",
-                 len(motifs), impl)
+        seq_size = dict(topology.device_axes())["seq"]
+        loss_fn, impl = seq_rewritten_loss(  # noqa: F811 — deliberate
+            loss_fn, seq_size, topology.to_jax_mesh(devices),
+            params, *example_batch)
+        log.info("seq axis -> %s attention", impl)
 
     # REMAT_POLICY knob: rematerialization trades FLOPs for activation
     # memory (jax.checkpoint; the stage modules already remat via VJP).
