@@ -25,9 +25,17 @@ COMM_OVERLAP factor discounts exposed collective time multiplicatively
 for XLA's async-collective overlap. Validated against measured CPU-mesh
 step times in tests/test_evaluator_measured.py (argmin agreement over
 annotation-forced dp/tp/tp0 plans) and tests/test_evaluator.py
-(replicated-vs-sharded). Known blind spot: cross-axis conflicts resolved
-by GSPMD involuntary rematerialization are under-priced (per-axis
-re-derivation cannot see them)."""
+(replicated-vs-sharded).
+
+v4 (VERDICT r4 #6): cross-axis conflicts are priced — a split input
+consumed by a node left replicated on an axis pays the gather GSPMD
+performs unless the op provably carries the split (_hidden_gather_time,
+with forward-inference/structural carry checks so clean DP plans price
+zero phantom gathers), and an entangled partition-dim change (the var is
+split on another axis) upgrades from all-to-all to full-remat pricing
+(_reshard_time). Remaining documented gap: pathologies created INSIDE
+lowering by device-order permutations of the composed mesh (transposed
+tile assignments XLA remats) are invisible to any pre-lowering model."""
 
 from __future__ import annotations
 
@@ -71,18 +79,30 @@ class Evaluator:
 
     # -- SPMD ------------------------------------------------------------
     def _reshard_time(self, graph: JaxprGraph, gs: GraphStrategy,
-                      produced: Optional[Dict] = None) -> float:
+                      produced: Optional[Dict] = None,
+                      cross_split_vars: Optional[set] = None) -> float:
         """Price reshard edges for one axis: each node's input demand
         (back-inferred from its chosen output strategy) vs what the
         producer actually emits (reference: the reshard CustomCollectives
         SpmdTransform would insert; priced but never materialised here —
-        GSPMD emits the real ones)."""
+        GSPMD emits the real ones).
+
+        ``cross_split_vars``: vars split (produced or demanded) on ANOTHER
+        mesh axis. A partition-DIM change on this axis for such a var is
+        an entangled cross-axis transition GSPMD cannot lower as a cheap
+        all-to-all — it falls back to "Involuntary full rematerialization"
+        (replicate, then re-partition; spmd_partitioner.cc) — so it is
+        priced as the full-bytes all-gather that remat performs
+        (VERDICT r4 #6; measured 2.5x pathology in
+        tests/test_evaluator_measured.py)."""
         from jax.extend.core import Var
 
+        from tepdist_tpu.core.dist_spec import DimStrategy as _DS
         from tepdist_tpu.parallel.strategy_utils import StrategyUtil
 
         if produced is None:
             produced = self._produced_map(graph, gs)
+        repl = _DS.make_replicated(gs.num_splits)
         t = 0.0
         for node in graph.nodes:
             outs = gs.node_out.get(node.id)
@@ -111,9 +131,43 @@ class Evaluator:
                     src = produced.get(a)
                     if src is None or src.partial:
                         continue    # partial->psum priced separately
-                    t += transition_cost(src, want, aval_bytes(a.aval),
-                                         gs.num_splits, self.spec)
+                    cost = transition_cost(src, want, aval_bytes(a.aval),
+                                           gs.num_splits, self.spec)
+                    if (cross_split_vars and a in cross_split_vars
+                            and src.is_split() and want.is_split()
+                            and want.partition_dim != src.partition_dim):
+                        # Entangled cross-axis dim change: full remat.
+                        cost = max(cost, transition_cost(
+                            src, repl, aval_bytes(a.aval),
+                            self.topology.num_devices, self.spec))
+                    t += cost
         return t
+
+    @staticmethod
+    def _demanded_split_vars(graph: JaxprGraph, gs: GraphStrategy) -> set:
+        """Vars some consumer demands SPLIT on this axis (back-inferred
+        from split outputs) — one half of the cross-axis entanglement
+        signal."""
+        from jax.extend.core import Var
+
+        from tepdist_tpu.parallel.strategy_utils import StrategyUtil
+
+        out: set = set()
+        for node in graph.nodes:
+            outs = gs.node_out.get(node.id)
+            if not outs:
+                continue
+            for out_s in outs:
+                if out_s is None or not out_s.is_split():
+                    continue
+                r = StrategyUtil.back_infer(node.eqn, out_s, gs.num_splits)
+                if r is None:
+                    continue
+                for a, want in zip(node.invars, r.in_strategies):
+                    if (isinstance(a, Var) and want is not None
+                            and want.is_split()):
+                        out.add(a)
+        return out
 
     @staticmethod
     def _produced_map(graph: JaxprGraph, gs: GraphStrategy) -> Dict:
@@ -126,7 +180,8 @@ class Evaluator:
         return produced
 
     def derived_comm(self, graph: JaxprGraph, gs: GraphStrategy,
-                     produced: Optional[Dict] = None) -> float:
+                     produced: Optional[Dict] = None,
+                     cross_split_vars: Optional[set] = None) -> float:
         """Collective seconds of one axis's plan, re-derived from the final
         strategy assignment — psums at partial-resolution frontiers +
         reshard edges — with the planner's own comm_cost as a lower bound.
@@ -181,8 +236,99 @@ class Evaluator:
                         src, want, aval_bytes(a.aval), gs.num_splits,
                         self.spec)
         else:
-            coll += self._reshard_time(graph, gs, produced)
+            coll += self._reshard_time(graph, gs, produced,
+                                       cross_split_vars)
+        coll += self._hidden_gather_time(graph, gs, produced)
         return max(coll, gs.comm_cost or 0.0)
+
+    def _hidden_gather_time(self, graph: JaxprGraph, gs: GraphStrategy,
+                            produced: Dict) -> float:
+        """Cross-axis conflict rematerialization (VERDICT r4 #6): a split
+        input consumed by a node the planner left REPLICATED on this axis
+        is gathered by GSPMD over the axis ("Involuntary full
+        rematerialization", spmd_partitioner.cc) — typically because the
+        consumer's split lives on ANOTHER mesh axis, which the per-axis
+        demand back-inference cannot see (demands are only derived from
+        split outputs, so a replicated-on-this-axis consumer derives
+        none). Measured 2.5x pathology on the conflict fixture in
+        tests/test_evaluator_measured.py.
+
+        The planner's node marks are ADVISORY for intermediates (only
+        invar/outvar shardings are pinned at lowering; GSPMD propagates
+        the rest), so a planner-replicated node whose op can CARRY the
+        input's split (forward inference yields a split output — every
+        elementwise op) is computed sharded by GSPMD and priced zero
+        here. Only ops the split cannot flow through (forward inference
+        fails, or degrades to a partial the plan never resolves) pay the
+        gather."""
+        from jax.extend.core import Var
+
+        from tepdist_tpu.core.dist_spec import DimStrategy as _DS
+        from tepdist_tpu.parallel.strategy_utils import StrategyUtil
+
+        repl = _DS.make_replicated(gs.num_splits)
+        gathered: set = set()   # one gather per var on this axis
+        t = 0.0
+        for node in graph.nodes:
+            outs = gs.node_out.get(node.id)
+            if not outs or all(s is None for s in outs):
+                continue        # glue/unassigned: GSPMD keeps it sharded
+            if any(s is not None and (s.is_split() or s.partial)
+                   for s in outs):
+                continue        # node participates on this axis: the
+                                # normal demand machinery prices it
+            for pos, a in enumerate(node.invars):
+                if not isinstance(a, Var) or a in gathered:
+                    continue
+                src = produced.get(a)
+                if src is None or not src.is_split() or src.partial:
+                    continue
+                if self._split_carries(node, pos, a, src, gs.num_splits):
+                    continue    # GSPMD carries the split through
+                gathered.add(a)
+                t += transition_cost(src, repl, aval_bytes(a.aval),
+                                     gs.num_splits, self.spec)
+        return t
+
+    @staticmethod
+    def _split_carries(node, pos: int, a, src, num_splits: int) -> bool:
+        """Can GSPMD propagate this operand's split through the op
+        without comm? Ops the inference rules know (dot/conv/reduce/
+        dim-mapped) answer via forward inference — a split output means
+        carry, a partial/None means real comm. Ops OUTSIDE the rule
+        table (add_any, broadcast elementwise, most transparent glue)
+        default to the structural check: the output preserves the split
+        dim, so slicing commutes with the op. Opaque ops that fail both
+        default to carry=True, i.e. priced zero — the pre-r5 behavior
+        (never over-price what we cannot model)."""
+        from tepdist_tpu.parallel.strategy_utils import (
+            StrategyUtil,
+            dim_maps,
+        )
+
+        try:
+            fwd = StrategyUtil.forward_infer(node.eqn, {pos: src},
+                                             num_splits)
+        except Exception:  # noqa: BLE001 — unknown op
+            fwd = None
+        if fwd is not None:
+            return any(s is not None and s.is_split()
+                       for s in fwd.out_strategies)
+        try:
+            known_op = (node.eqn.primitive.name in
+                        ("dot_general", "conv_general_dilated")
+                        or dim_maps(node.eqn) is not None)
+        except Exception:  # noqa: BLE001
+            known_op = False
+        if known_op:
+            return False        # the rules understood it and said comm
+        # Structural fallback: output keeps the operand's split dim.
+        d = src.partition_dim
+        out_shape = node.outvars[0].aval.shape if node.outvars else ()
+        in_shape = a.aval.shape
+        return (d < len(out_shape) and d < len(in_shape)
+                and len(out_shape) == len(in_shape)
+                and out_shape[d] == in_shape[d])
 
     def run(self, graph: JaxprGraph,
             strategies: Sequence[GraphStrategy],
@@ -222,9 +368,24 @@ class Evaluator:
         # cones (glue-node conflicts GSPMD resolves at runtime, partial
         # grads resolved at the apply boundary) — trusting it verbatim
         # reported comm=0 for plans whose measured step is comm-dominated.
-        coll_t = sum(
-            self.derived_comm(graph, gs, produced)
-            for gs, produced in zip(strategies, produced_maps))
+        # Cross-axis entanglement context: vars split (produced or
+        # demanded) on each axis, so axis i's reshard pricing can detect
+        # dim changes GSPMD must lower as full rematerialization.
+        split_vars_per_axis = []
+        if len(strategies) > 1:
+            for gs, prod in zip(strategies, produced_maps):
+                sv = {a for a, s in prod.items()
+                      if s is not None and s.is_split()}
+                sv |= self._demanded_split_vars(graph, gs)
+                split_vars_per_axis.append(sv)
+        coll_t = 0.0
+        for i, (gs, produced) in enumerate(zip(strategies, produced_maps)):
+            cross = None
+            if split_vars_per_axis:
+                cross = set().union(*(sv for j, sv in
+                                      enumerate(split_vars_per_axis)
+                                      if j != i)) or None
+            coll_t += self.derived_comm(graph, gs, produced, cross)
 
         # Memory: parameters (sharded where split) + activation peak.
         from tepdist_tpu.parallel.sync_free import (

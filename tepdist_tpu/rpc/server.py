@@ -106,6 +106,7 @@ class _CompiledPipelinePlan:
         self.strategies_summary = strategies_summary
         self.shardings = None
         self.loaded = False
+        self.retired = False
 
     def load_from_store(self, variables, with_opt_state: bool):
         """Pull params (and optionally optimizer slots) from the servicer's
@@ -213,14 +214,18 @@ class TepdistServicer:
                 ap.sync_to_store(self.variables)
 
     def _retire_active_pipeline(self) -> None:
-        """A new plan supersedes the live pipeline runtime: flush its
-        state once (a follow-up plan — e.g. compile_generate — must see
-        the trained weights) and stop treating it as the store's source
-        of truth."""
+        """A new STATE-WRITING plan supersedes the live pipeline runtime:
+        flush its state once and stop treating it as the store's source
+        of truth. The retired runtime refuses further steps — training
+        through a detached handle would be invisible to every store
+        reader (fetch/save/generate). Read-only plans (compile_generate:
+        empty state_alias) do NOT retire the runtime; they read through
+        the sync-before-read invariant instead."""
         ap = getattr(self, "_active_pipeline", None)
         if ap is None:
             return
         self._sync_active_pipeline()
+        ap.retired = True
         self._active_pipeline = None
 
     def my_cluster_ip(self) -> str:
@@ -339,7 +344,11 @@ class TepdistServicer:
             num_micro_batches=M,
             include_pipeline=(optimizer is not None
                               and micro_loss_fn is not None),
-            include_seq=optimizer is not None,
+            # A seq winner re-composes the step with GA slicing — which
+            # evaluates the loss at MICRO shapes, so it needs the
+            # micro-shape trace just like pipeline winners do.
+            include_seq=(optimizer is not None
+                         and micro_loss_fn is not None),
             pipeline_loss_fn=micro_loss_fn,
             pipeline_micro_options=[M])
         explored = {
@@ -360,9 +369,17 @@ class TepdistServicer:
         optimizer apply; the client-side composition in
         client/session.py:compile_training, mirrored) — used when the
         explore winner needs a different step than the shipped one (seq
-        rewrite). Returns the traced step ClosedJaxpr."""
+        rewrite). Returns the traced step ClosedJaxpr.
+
+        ``loss_fn`` must be valid at the shapes GA evaluates it at: the
+        MICRO-shape reconstruction when num_micro_batches > 1 (jaxpr
+        constants bake the trace shape — build_ga_step slices the batch
+        to exactly the micro jaxpr's shapes), the full-batch one at
+        M == 1. The caller guarantees this via _explore_plan's
+        include_seq gating."""
         import optax
 
+        from tepdist_tpu.parallel.pipeline import micro_abstract_batch
         from tepdist_tpu.parallel.sync_free import build_ga_step
 
         if topology is not None and any(
@@ -372,9 +389,13 @@ class TepdistServicer:
             )
 
             seq_size = dict(topology.device_axes())["seq"]
+            # Rewrite at the shapes the loss will be EVALUATED at.
+            micro_sds = (micro_abstract_batch(tuple(batch_sds),
+                                              num_micro_batches)
+                         if num_micro_batches > 1 else tuple(batch_sds))
             loss_fn, _impl = seq_rewritten_loss(  # noqa: F811
                 loss_fn, seq_size, topology.to_jax_mesh(self.devices),
-                params_sds, *batch_sds)
+                params_sds, *micro_sds)
 
         def grad_fn(p, *b):
             return jax.value_and_grad(loss_fn)(p, *b)
@@ -461,10 +482,12 @@ class TepdistServicer:
         header, blobs = protocol.unpack(request)
         opts = header.get("options", {})
         t0 = time.time()
-        # A new plan supersedes any live pipeline runtime as the store's
-        # source of truth (its trained state is flushed first, so e.g. a
-        # follow-up compile_generate reads the trained weights).
-        self._retire_active_pipeline()
+        # A new STATE-WRITING plan (training: non-empty state_alias)
+        # supersedes any live pipeline runtime as the store's source of
+        # truth. Read-only plans (compile_generate) leave it active —
+        # they see its live weights via the sync-before-read invariant.
+        if opts.get("state_alias"):
+            self._retire_active_pipeline()
         closed = deserialize_closed_jaxpr(blobs[0])
 
         from tepdist_tpu.graph.jaxpr_graph import JaxprGraph
@@ -491,10 +514,13 @@ class TepdistServicer:
                    for n, s in topology_w.device_axes()):
                 # The shipped step traced plain attention; the seq winner
                 # executes the ring/Ulysses rewrite — re-compose the step
-                # server-side and plan THAT.
+                # server-side and plan THAT. GA evaluates the loss at
+                # micro shapes, so M > 1 uses the micro-shape
+                # reconstruction (jaxpr constants bake the trace shape).
+                M_c = max(int(opts.get("num_micro_batches", 1)), 1)
                 closed = self._recompose_step(
-                    loss_fn, optimizer,
-                    max(int(opts.get("num_micro_batches", 1)), 1),
+                    best["_micro_loss_fn"] if M_c > 1 else loss_fn,
+                    optimizer, M_c,
                     topology_w, params_sds, batch_sds, n_state_client)
 
         graph = JaxprGraph(closed, inline=False)
@@ -646,6 +672,11 @@ class TepdistServicer:
         batch leaves route to the task-graph runtime; state lives in the
         per-stage executable and syncs through the variable store on
         fetch/save/restore."""
+        if plan.retired:
+            raise RuntimeError(
+                "pipeline plan was superseded by a newer state-writing "
+                "plan; its runtime is detached from the variable store — "
+                "recompile instead of stepping the old handle")
         fetch = bool(header.get("fetch_resource_variables"))
         if self.ckpt_opts.get("restore"):
             self._do_restore(self.ckpt_opts.pop("restore"))

@@ -16,11 +16,13 @@ r2 state where every topology priced identically because comm collapsed
 to zero), and every comm-bearing plan reports nonzero exposed collective
 time.
 
-Known blind spot, documented not asserted: CROSS-axis sharding conflicts
-(split on mesh axis x produced, split on y demanded) are resolved by
-GSPMD with involuntary full rematerialization; per-axis re-derivation
-cannot see them, so hybrid dp x tp plans with conflicting annotations are
-under-priced relative to their (pathological) measured time.
+Cross-axis conflicts (split on mesh axis x produced, split on y
+demanded — GSPMD resolves them with involuntary rematerialization) are
+PRICED since r5: the evaluator's hidden-gather pass charges the
+all-gather GSPMD performs for a split input consumed by a node left
+replicated on that axis, and entangled partition-dim changes upgrade to
+full-remat pricing (evaluator.py:_hidden_gather_time/_reshard_time;
+asserted below in test_cross_axis_conflict_priced_and_loses).
 """
 
 import time
@@ -224,3 +226,102 @@ def test_explore_candidate_ranking_vs_measured(devices, n_devices, tol,
         f"meas={ {k: round(v * 1e3, 1) for k, v in meas.items()} }")
     # The analytic costs must discriminate across the candidate kinds.
     assert max(evals.values()) / min(evals.values()) >= 1.1
+
+
+def test_cross_axis_conflict_priced_and_loses(devices):
+    """VERDICT r4 #6: a hybrid plan with a cross-axis produced/demanded
+    conflict — h produced col-split on axis y (w1 pinned y-col) while its
+    consumer's split lives on axis x (w2 pinned x-col) — must price ABOVE
+    the clean plan and lose the measured argmin at n=8. The pricing comes
+    from the r5 machinery: the y-gather of h is charged (hidden-gather
+    pass / the planner's own comm objective, which the pass floors), and
+    entangled partition-dim changes upgrade to full-remat pricing.
+
+    Remaining documented gap (NOT the original caveat, which this test
+    retires): when the lowered COMPOSITION of per-axis shardings forces a
+    device-ORDER permutation (e.g. w2 pinned x-ROW-split composed with
+    state-storage alignment on y produces a transposed tile assignment
+    XLA remats), the pathology is created inside lowering and is invisible
+    to any pre-lowering cost model on this architecture."""
+    import optax
+
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device mesh")
+
+    def loss(params, x, y):
+        h = x @ params["w1"]
+        o = h @ params["w2"]
+        return jnp.mean((o - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    D, B = 512, 64
+    params = {"w1": jax.random.normal(k, (D, D)) * 0.05,
+              "w2": jax.random.normal(k, (D, D)) * 0.05}
+    x = jax.random.normal(k, (B, D))
+    y = jnp.zeros((B, D))
+    graph, _, _ = trace_graph(jax.value_and_grad(loss), params, x, y)
+    topo = MeshTopology([("x", 2), ("y", 4)])
+    conflict = {0: {"y": DimStrategy.split_on(1, 4)},
+                1: {"x": DimStrategy.split_on(1, 2)}}
+    # Clean comparator: plain DP on x (batch split), nothing conflicted.
+    clean = {2: {"x": DimStrategy.split_on(0, 2)},
+             3: {"x": DimStrategy.split_on(0, 2)}}
+
+    ev = Evaluator(topo)
+    costs = {}
+    for name, ann in [("conflict", conflict), ("clean", clean)]:
+        strategies = plan_axes(graph, topo, ann, "cost")
+        costs[name] = ev.run(graph, strategies)
+
+    # Ranked correctly, with a decisive margin: the conflict's cross-axis
+    # comm (h gathered over y every step, w-grads resharded) prices above
+    # clean DP's grad psums.
+    assert (costs["conflict"].total_duration
+            > 1.20 * costs["clean"].total_duration), (
+        costs["conflict"].total_duration, costs["clean"].total_duration)
+    # And the conflict's collective time is genuinely nonzero (the
+    # original caveat's failure mode was comm priced ~0 for plans whose
+    # measured step is comm-dominated).
+    assert costs["conflict"].coll_ratio > 0.3
+
+    # And the measurement agrees: the conflict plan loses.
+    tx = optax.sgd(0.01)
+    opt_state = tx.init(params)
+
+    def train_step(params, opt_state, x, y):
+        l, g = jax.value_and_grad(loss)(params, x, y)
+        u, opt_state = tx.update(g, opt_state, params)
+        return l, optax.apply_updates(params, u), opt_state
+
+    n_state = len(jax.tree_util.tree_leaves((params, opt_state)))
+    meas = {}
+    for name, ann in [("conflict", conflict), ("clean", clean)]:
+        plan = auto_parallel(train_step, topo, params, opt_state, x, y,
+                             annotations=ann,
+                             state_alias={1 + i: i
+                                          for i in range(n_state)})
+        step = plan.executable()
+        flat, _ = jax.tree_util.tree_flatten(
+            ((params, opt_state, x, y), {}))
+        flat = [jax.device_put(v, s)
+                for v, s in zip(flat, plan.input_shardings())]
+
+        def thread(flat, outs):
+            n = len(outs) - 1
+            return list(outs[1:]) + flat[n:]
+
+        for _ in range(2):
+            outs = step(*flat)
+            float(jax.device_get(outs[0]))
+            flat = thread(flat, outs)
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                outs = step(*flat)
+                flat = thread(flat, outs)
+            float(jax.device_get(outs[0]))
+            dt = (time.perf_counter() - t0) / 10
+            best = dt if best is None else min(best, dt)
+        meas[name] = best
+    assert meas["conflict"] > meas["clean"], meas
