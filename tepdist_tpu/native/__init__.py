@@ -69,15 +69,23 @@ KIND_FWD, KIND_BWD, KIND_OTHER = 0, 1, 2
 def schedule_native(
     kind: Sequence[int],
     duration: Sequence[float],
+    occupancy: Sequence[float],
     stage: Sequence[int],
     micro: Sequence[int],
     device_groups: Sequence[Sequence[int]],
     children: Sequence[Sequence[int]],
     n_parents: Sequence[int],
     window: int,
+    rank: Optional[Sequence[int]] = None,
 ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Run the C++ simulation; returns (order, start, finish) or None if the
-    native library is unavailable."""
+    native library is unavailable.
+
+    ``rank``: per-task priority ranks (lower starts first among startable
+    tasks; ties by id) — the schedule POLICY, computed by the Python layer
+    (task_scheduler._rank_*) so standard and interleaved-1F1B candidates
+    share one simulator. Defaults to the standard 1F1B policy
+    (micro * 2 + (0 if bwd else 1))."""
     lib = _load()
     if lib is None:
         return None
@@ -96,8 +104,14 @@ def schedule_native(
     ch_off, ch_ids = csr(children)
     kind_a = np.asarray(kind, i32)
     dur_a = np.asarray(duration, np.float64)
+    occ_a = np.asarray(occupancy, np.float64)
     stage_a = np.asarray(stage, i32)
     micro_a = np.asarray(micro, i32)
+    if rank is None:
+        rank_a = (np.maximum(micro_a, 0).astype(np.int64) * 2
+                  + (kind_a != KIND_BWD).astype(np.int64))
+    else:
+        rank_a = np.asarray(rank, np.int64)
     np_a = np.asarray(n_parents, i32)
     order = np.zeros(n, i32)
     start = np.zeros(n, np.float64)
@@ -107,8 +121,9 @@ def schedule_native(
         return arr.ctypes.data_as(ctypes.c_void_p)
 
     rc = lib.tepdist_schedule(
-        ctypes.c_int32(n), p(kind_a), p(dur_a), p(stage_a), p(micro_a),
-        p(dev_off), p(dev_ids), p(ch_off), p(ch_ids), p(np_a),
+        ctypes.c_int32(n), p(kind_a), p(dur_a), p(occ_a), p(stage_a),
+        p(micro_a),
+        p(rank_a), p(dev_off), p(dev_ids), p(ch_off), p(ch_ids), p(np_a),
         ctypes.c_int32(window), p(order), p(start), p(finish))
     if rc != 0:
         raise RuntimeError("native schedule: deadlock (DAG cycle)")

@@ -3,9 +3,12 @@
 // Reference parity: the discrete-event simulation hot loop of
 // TaskScheduler::Schedule (reference: pjrt/task_scheduler.{h,cc} —
 // ClusterState::ScheduleNextTask / MarkTaskDoneByTime per device until
-// AllFinished). The Python layer builds the DAG and interprets the result;
-// this core runs the event-driven simulation, which dominates planner time
-// for large (stage x micro) DAGs.
+// AllFinished). The Python layer builds the DAG, computes per-task
+// PRIORITY RANKS (the schedule policy: standard 1F1B or Megatron
+// interleaved-1F1B — reference GROUP_SCHED_COUNT candidate schedules +
+// Reorder post-passes), and interprets the result; this core runs the
+// event-driven simulation, which dominates planner time for large
+// (stage x micro) DAGs.
 //
 // A task starts only when every parent has FINISHED in simulated time and
 // all its devices are free at the current instant; the 1F1B window is a
@@ -39,8 +42,10 @@ extern "C" int tepdist_schedule(
     int32_t n_tasks,
     const int32_t* kind,          // TaskKind per task
     const double* duration,
+    const double* occupancy,      // device-hold time (<= duration for async transport)
     const int32_t* stage,
     const int32_t* micro,
+    const int64_t* rank,          // policy priority rank per task
     const int32_t* dev_offsets,   // CSR [n_tasks+1]
     const int32_t* dev_ids,
     const int32_t* child_offsets, // CSR [n_tasks+1]
@@ -66,7 +71,7 @@ extern "C" int tepdist_schedule(
   double t_now = 0.0;
   int32_t done = 0;
 
-  using Prio = std::tuple<int32_t, int32_t, int32_t>;  // micro, bwd, id
+  using Prio = std::pair<int64_t, int32_t>;  // rank, id
   auto try_start = [&]() -> bool {
     int32_t best = -1;
     size_t best_idx = 0;
@@ -83,14 +88,13 @@ extern "C" int tepdist_schedule(
       }
       if (!devs_free) continue;
       bool is_fwd = kind[t] == kComputeFwd;
-      bool is_bwd = kind[t] == kComputeBwd;
       if (is_fwd && window > 0) {
         auto& s = inflight[stage[t]];
         if (!s.count(micro[t]) && (int32_t)s.size() >= window) {
           continue;  // 1F1B gate: stage window full
         }
       }
-      Prio pr{micro[t] >= 0 ? micro[t] : 0, is_bwd ? 0 : 1, t};
+      Prio pr{rank[t], t};
       if (best < 0 || pr < best_pr) {
         best = t;
         best_idx = pi;
@@ -100,15 +104,17 @@ extern "C" int tepdist_schedule(
     if (best < 0) return false;
     pool.erase(pool.begin() + best_idx);
     double fin = t_now + duration[best];
+    double rel = t_now + occupancy[best];
     out_order[done] = best;
     out_start[best] = t_now;
     out_finish[best] = fin;
     ++done;
     for (int32_t i = dev_offsets[best]; i < dev_offsets[best + 1]; ++i) {
-      dev_free[dev_ids[i]] = fin;
+      dev_free[dev_ids[i]] = rel;
     }
     if (kind[best] == kComputeFwd) inflight[stage[best]].insert(micro[best]);
     events.push({fin, best});
+    if (rel < fin) events.push({rel, -1});  // async release: wake the scan
     return true;
   };
 
@@ -121,6 +127,7 @@ extern "C" int tepdist_schedule(
     while (!events.empty() && events.top().first == t_now) {
       int32_t t = events.top().second;
       events.pop();
+      if (t < 0) continue;  // sentinel: device-release wake only
       if (kind[t] == kComputeBwd) inflight[stage[t]].erase(micro[t]);
       for (int32_t i = child_offsets[t]; i < child_offsets[t + 1]; ++i) {
         int32_t c = child_ids[i];

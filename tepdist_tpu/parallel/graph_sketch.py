@@ -181,19 +181,51 @@ class GraphSketch:
         from scipy import sparse
         from scipy.optimize import Bounds, LinearConstraint, milp
 
+        from tepdist_tpu.parallel.performance_utils import (
+            PerfUtils,
+            chip_spec,
+        )
+
         N = len(self.nodes)
-        # y[n,s] for s in 0..S-2  (y[n,S-1] == 1 implicitly).
+        # y[n,s] for s in 0..S-2  (y[n,S-1] == 1 implicitly), plus ONE
+        # continuous bottleneck variable T >= stage_flops_s for every s.
         def yi(n: int, s: int) -> int:
             return n * (S - 1) + s
 
-        nvars = N * (S - 1)
+        nvars = N * (S - 1) + 1
+        ti = nvars - 1
         obj = np.zeros(nvars)
-        # objective: sum_e w_e * (stage(dst)-stage(src));
+        # Objective in SECONDS: cross-stage traffic + the bottleneck
+        # stage's compute time. On a chain graph the traffic term alone is
+        # cut-location-INVARIANT (sum of stage gaps == S-1 whatever the
+        # cut), so without the bottleneck term the solver may legally park
+        # 3/4 of the model in one stage (ratio-8 balance bound) — the
+        # makespan of a 1F1B pipeline is bottleneck-stage-bound
+        # (reference: flop balance via UNBALANCED_RATIO, service_env.h:58;
+        # the bottleneck term makes balance an OBJECTIVE, not just a
+        # feasibility band).
+        env_bw = ServiceEnv.get().pp_bandwidth
+        spec = chip_spec()
+        sec_per_byte = 1.0 / ((env_bw if env_bw > 0 else spec.dcn_gbps)
+                              * 1e9)
+        sec_per_flop = PerfUtils.compute_time(1.0, spec)
+        # NORMALIZED units: one "stage share" of compute time == 1.0, so
+        # every coefficient is O(1) whatever the model size. Raw flop
+        # counts (~1e9+) against unit y coefficients wreck HiGHS's
+        # scaling (it returned certifiably suboptimal "optimal" points),
+        # and raw seconds (~1e-9 for tiny graphs) sink below its
+        # feasibility tolerance.
+        total_sec = max(self.total_flops() * sec_per_flop, 1e-30)
+        unit = total_sec / S
+        sec_per_byte /= unit
+        sec_per_flop /= unit
+        obj[ti] = 1.0
+        # traffic: sum_e w_e * (stage(dst)-stage(src));
         # stage(n) = (S-1) - sum_s y[n,s]  =>  contributes +w on src y, -w on dst y
         for a, b, w in self._edges():
             for s in range(S - 1):
-                obj[yi(a, s)] += w
-                obj[yi(b, s)] -= w
+                obj[yi(a, s)] += w * sec_per_byte
+                obj[yi(b, s)] -= w * sec_per_byte
 
         rows_data: List[Tuple[List[int], List[float], float, float]] = []
         # Monotonicity: y[n,s] <= y[n,s+1]
@@ -208,7 +240,7 @@ class GraphSketch:
                                   -np.inf, 0.0))
         # Flop balance per stage: x[n,s] = y[n,s] - y[n,s-1] (y[n,-1]=0,
         # x[n,S-1] = 1 - y[n,S-2]).
-        total = self.total_flops()
+        total = S * 1.0                      # normalized: total == S units
         lo_share = total / (S * ratio)
         hi_share = total * ratio / S
         for s in range(S):
@@ -216,7 +248,7 @@ class GraphSketch:
             coefs: List[float] = []
             const = 0.0
             for n, sn in enumerate(self.nodes):
-                f = sn.flops
+                f = sn.flops * sec_per_flop
                 if f == 0:
                     continue
                 if s == 0:
@@ -232,6 +264,8 @@ class GraphSketch:
                     idxs.append(yi(n, S - 2))
                     coefs.append(-f)
             rows_data.append((idxs, coefs, lo_share - const, hi_share - const))
+            # Bottleneck link: stage_flops_s <= T.
+            rows_data.append((idxs + [ti], coefs + [-1.0], -np.inf, -const))
 
         data, ri, ci, lo, hi = [], [], [], [], []
         for r, (idxs, coefs, lb, ub) in enumerate(rows_data):
@@ -242,11 +276,15 @@ class GraphSketch:
             lo.append(lb)
             hi.append(ub)
         A = sparse.csr_matrix((data, (ri, ci)), shape=(len(rows_data), nvars))
+        integrality = np.ones(nvars)
+        integrality[ti] = 0                   # T is continuous
+        ub_vars = np.ones(nvars)
+        ub_vars[ti] = np.inf
         res = milp(
             c=obj,
             constraints=LinearConstraint(A, np.array(lo), np.array(hi)),
-            integrality=np.ones(nvars),
-            bounds=Bounds(0, 1),
+            integrality=integrality,
+            bounds=Bounds(0, ub_vars),
             options={"time_limit": time_limit},
         )
         if res.x is None:

@@ -78,10 +78,11 @@ class PipelineExecutable:
         device groups and assign them round-robin (stage s -> group
         s % G, the multiworker layout in-process); hops between
         co-resident stages are direct edges (no send/recv). S must be a
-        multiple of the group count. NOTE: the greedy event scheduler
-        does not yet realize the Megatron interleaved-1F1B bubble gain
-        (NOTES_NEXT #7) — use this to run more stages than device groups,
-        not as a bubble optimization."""
+        multiple of the group count. The scheduler's candidate search
+        includes a Megatron chunk-alternating priority for interleaved
+        placements and realizes the interleaved-1F1B bubble gain in the
+        warmup-dominated regime (deep p, modest M, hops cheap vs stage
+        compute — tests/test_interleaved_schedule.py)."""
         self.prog = prog
         S = prog.num_stages
         devices = list(devices if devices is not None else jax.devices())

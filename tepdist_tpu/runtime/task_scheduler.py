@@ -19,8 +19,20 @@ import heapq
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from tepdist_tpu.core.service_env import ServiceEnv
-from tepdist_tpu.parallel.performance_utils import PerfUtils, chip_spec
+from tepdist_tpu.parallel.performance_utils import (
+    ALPHA_S,
+    PerfUtils,
+    chip_spec,
+)
 from tepdist_tpu.runtime.task_graph import TaskDAG, TaskNode, TaskType
+
+# Device-occupying WORK for bubble accounting: compute, gradient
+# accumulation, optimizer apply, and collectives all hold the device and
+# are not pipeline bubble; transport tasks (SEND/RECV) model link latency
+# and stay outside "busy" (reference: bubble = pipeline idle, DevState
+# busy spans, pjrt/task_scheduler.h).
+_BUSY_TYPES = (TaskType.COMPUTE, TaskType.GA, TaskType.GAINIT,
+               TaskType.APPLY, TaskType.AR)
 
 
 @dataclasses.dataclass
@@ -37,6 +49,9 @@ class ScheduleResult:
     # pjrt/task_scheduler.h:86-180 — an OOM schedule is never selected
     # while a feasible candidate window exists.
     memory_feasible: bool = True
+    # Which priority policy produced this schedule ("standard" 1F1B or
+    # "interleaved" Megatron-1F1B chunk alternation).
+    policy: str = "standard"
 
     def device_list(self, dev: int) -> List[int]:
         out = []
@@ -98,6 +113,18 @@ class TaskScheduler:
         self.mem_limit = mem_limit_bytes
 
     # -- time model -------------------------------------------------------
+    def occupancy_time(self, n: TaskNode) -> float:
+        """How long the task HOLDS its devices. Transport tasks (SEND/
+        RECV) are async DMAs on TPU — the device pays only the launch
+        alpha while the wire latency gates the CONSUMER (task_time), so
+        extra pipeline hops (interleaved placements) do not serialize
+        against compute (reference: ASYNC_SEND/ASYNC_RECV,
+        service_env.h:46-47 — PJRT dispatch is async)."""
+        t = self.task_time(n)
+        if n.task_type in (TaskType.SEND, TaskType.RECV):
+            return min(t, ALPHA_S)
+        return t
+
     def task_time(self, n: TaskNode) -> float:
         if n.task_type == TaskType.COMPUTE:
             ndev = max(len(n.device_group), 1)
@@ -125,23 +152,87 @@ class TaskScheduler:
             return max(PerfUtils.hbm_time(n.out_bytes, self.spec), 1e-7)
         return 1e-8
 
+    # -- priority policies ------------------------------------------------
+    def _interleave_factors(self) -> Optional[Tuple[int, int]]:
+        """(G device groups, v chunks per group) when the DAG runs MORE
+        pipeline stages than device groups (interleaved placement, stage
+        s -> group s % G); None for blocked placements. Cached — called
+        per policy/rank/window within one schedule()."""
+        if hasattr(self, "_ifactors"):
+            return self._ifactors
+        stages = {n.stage for n in self.dag.nodes
+                  if n.task_type == TaskType.COMPUTE and n.stage >= 0}
+        groups = {tuple(n.device_group) for n in self.dag.nodes
+                  if n.task_type == TaskType.COMPUTE and n.device_group}
+        S, G = len(stages), len(groups)
+        self._ifactors = ((G, S // G)
+                          if G >= 1 and S > G and S % G == 0 else None)
+        return self._ifactors
+
+    def _ranks(self, policy: str) -> List[int]:
+        """Per-task priority rank (lower starts first; ties by id) — THE
+        scheduling policy, shared verbatim with the native core.
+
+        standard: (micro, bwd-before-fwd) — classic 1F1B drain-over-fill.
+
+        interleaved (reference: the Megatron interleaved-1F1B order the
+        reference approximates with Reorder post-passes,
+        task_scheduler.h:347-374): each device holds v model chunks
+        (virtual stages); micros advance in ROUNDS of G, and within a
+        round a device runs chunk 0's G forwards before chunk 1's — the
+        virtual micro index vm = (m//G)*v*G + chunk*G + m%G linearizes
+        that order, with backwards draining chunks in reverse."""
+        factors = self._interleave_factors()
+        ranks: List[int] = []
+        for n in self.dag.nodes:
+            m = n.micro if n.micro >= 0 else 0
+            bwd = (n.task_type == TaskType.COMPUTE and "bwd" in n.name)
+            if policy == "standard" or factors is None:
+                ranks.append(m * 2 + (0 if bwd else 1))
+                continue
+            G, v = factors
+            c = n.stage // G if n.stage >= 0 else 0
+            cc = (v - 1 - c) if bwd else c
+            vm = (m // G) * v * G + cc * G + (m % G)
+            ranks.append(vm * 2 + (0 if bwd else 1))
+        return ranks
+
+    def _policies(self) -> List[str]:
+        return (["standard", "interleaved"]
+                if self._interleave_factors() is not None
+                else ["standard"])
+
     # -- scheduling -------------------------------------------------------
     def schedule(self) -> ScheduleResult:
-        """Try GROUP_SCHED_COUNT window policies, keep the best makespan
-        among memory-feasible candidates (reference: candidate schedules
-        loop + DevState OOM state, pjrt/task_scheduler.h:86-180). Wider
-        1F1B windows trade peak activation memory for bubble time; when a
-        window's simulated peak exceeds ``mem_limit_bytes`` it is rejected,
-        and if every candidate is infeasible the search walks *narrower*
-        windows (fewer in-flight micros) until one fits. Only when no
-        window fits at all is the min-peak schedule returned, flagged
-        ``memory_feasible=False``."""
+        """Try GROUP_SCHED_COUNT window policies x priority policies, keep
+        the best makespan among memory-feasible candidates (reference:
+        candidate schedules loop + Reorder post-passes + DevState OOM
+        state, pjrt/task_scheduler.h:86-180,347-374). Wider 1F1B windows
+        trade peak activation memory for bubble time; when a window's
+        simulated peak exceeds ``mem_limit_bytes`` it is rejected, and if
+        every candidate is infeasible the search walks *narrower* windows
+        (fewer in-flight micros) until one fits. Only when no window fits
+        at all is the min-peak schedule returned, flagged
+        ``memory_feasible=False``. Interleaved placements additionally
+        try the Megatron chunk-alternating priority (see _ranks) — the
+        best simulated candidate wins, so the policy never regresses a
+        blocked layout."""
         env = ServiceEnv.get()
         windows = [self.micro_limit]
         for delta in range(1, env.group_sched_count):
             w = self.micro_limit + delta
             windows.append(w)
-        results = [self._simulate(w) for w in windows[: env.group_sched_count]]
+        windows = windows[: env.group_sched_count]
+        factors = self._interleave_factors()
+        if factors is not None:
+            # A device holding v chunks at per-virtual-stage window w has
+            # ~v*w micros resident — each 1/v the blocked activation size
+            # — so the v-scaled windows are the SAME memory class as the
+            # blocked candidates (the mem_limit gate still arbitrates).
+            v = factors[1]
+            windows += [w * v for w in windows if w * v not in windows]
+        results = [self._simulate(w, policy=p)
+                   for p in self._policies() for w in windows]
         if self.mem_limit is not None:
             for r in results:
                 r.memory_feasible = (
@@ -149,13 +240,15 @@ class TaskScheduler:
             feasible = [r for r in results if r.memory_feasible]
             if not feasible:
                 for w in range(self.micro_limit - 1, 0, -1):
-                    r = self._simulate(w)
-                    r.memory_feasible = (
-                        max(r.peak_bytes.values(), default=0.0)
-                        <= self.mem_limit)
-                    results.append(r)
-                    if r.memory_feasible:
-                        feasible = [r]
+                    for p in self._policies():
+                        r = self._simulate(w, policy=p)
+                        r.memory_feasible = (
+                            max(r.peak_bytes.values(), default=0.0)
+                            <= self.mem_limit)
+                        results.append(r)
+                        if r.memory_feasible:
+                            feasible.append(r)
+                    if feasible:
                         break
             if feasible:
                 return min(feasible, key=lambda r: r.makespan)
@@ -164,15 +257,19 @@ class TaskScheduler:
                        key=lambda r: max(r.peak_bytes.values(), default=0.0))
         return min(results, key=lambda r: r.makespan)
 
-    def _simulate(self, window: int, use_native: Optional[bool] = None
-                  ) -> ScheduleResult:
+    def _simulate(self, window: int, use_native: Optional[bool] = None,
+                  policy: str = "standard") -> ScheduleResult:
         if use_native is None:
             use_native = len(self.dag.nodes) >= 256  # amortize call overhead
+        ranks = self._ranks(policy)
         if use_native:
-            r = self._simulate_native(window)
+            r = self._simulate_native(window, ranks)
             if r is not None:
+                r.policy = policy
                 return r
-        return self._simulate_py(window)
+        r = self._simulate_py(window, ranks)
+        r.policy = policy
+        return r
 
     def _native_arrays(self):
         """Marshal the DAG once per scheduler (schedule() simulates several
@@ -181,8 +278,8 @@ class TaskScheduler:
             from tepdist_tpu import native
 
             dag = self.dag
-            kind, dur, stage, micro, groups, children, n_parents = (
-                [], [], [], [], [], [], [])
+            kind, dur, occ, stage, micro, groups, children, n_parents = (
+                [], [], [], [], [], [], [], [])
             for n in dag.nodes:
                 if n.task_type == TaskType.COMPUTE and "bwd" in n.name:
                     kind.append(native.KIND_BWD)
@@ -191,25 +288,29 @@ class TaskScheduler:
                 else:
                     kind.append(native.KIND_OTHER)
                 dur.append(self.task_time(n))
+                occ.append(self.occupancy_time(n))
                 stage.append(n.stage)
                 micro.append(n.micro)
                 groups.append(list(n.device_group))
                 children.append(list(n.children))
                 n_parents.append(len(n.parents))
-            self._marshalled = (kind, dur, stage, micro, groups, children,
-                                n_parents)
+            self._marshalled = (kind, dur, occ, stage, micro, groups,
+                                children, n_parents)
         return self._marshalled
 
-    def _simulate_native(self, window: int) -> Optional[ScheduleResult]:
+    def _simulate_native(self, window: int,
+                         ranks: Optional[List[int]] = None
+                         ) -> Optional[ScheduleResult]:
         """C++ simulation core (tepdist_tpu/native/scheduler.cc); produces
         bit-identical schedules to the Python loop (tested)."""
         from tepdist_tpu import native
 
         dag = self.dag
-        (kind, dur, stage, micro, groups, children,
+        (kind, dur, occ, stage, micro, groups, children,
          n_parents) = self._native_arrays()
-        res = native.schedule_native(kind, dur, stage, micro, groups,
-                                     children, n_parents, window)
+        res = native.schedule_native(kind, dur, occ, stage, micro, groups,
+                                     children, n_parents, window,
+                                     rank=ranks)
         if res is None:
             return None
         order_a, start_a, finish_a = res
@@ -223,7 +324,7 @@ class TaskScheduler:
             per_device.setdefault(tuple(n.device_group), []).append(t)
             for d in n.device_group:
                 sim_busy[d] = sim_busy.get(d, 0.0) + (
-                    dur[t] if n.task_type == TaskType.COMPUTE else 0.0)
+                    dur[t] if n.task_type in _BUSY_TYPES else 0.0)
         makespan = max(finish.values(), default=0.0)
         peak = self._memory_account(order)
         ndev = max(len({d for g in per_device for d in g}), 1)
@@ -232,7 +333,8 @@ class TaskScheduler:
         return ScheduleResult(order, per_device, start, finish, makespan,
                               peak, bubble)
 
-    def _simulate_py(self, window: int) -> ScheduleResult:
+    def _simulate_py(self, window: int,
+                     ranks: Optional[List[int]] = None) -> ScheduleResult:
         """Event-driven simulation (reference: ClusterState::ScheduleNextTask
         + MarkTaskDoneByTime, pjrt/task_scheduler.cc): a task STARTS only
         when every parent has *finished in simulated time* and its devices
@@ -244,6 +346,8 @@ class TaskScheduler:
         are in flight on its stage), which is exactly the bubble-vs-peak-
         memory trade the mem_limit search explores."""
         dag = self.dag
+        if ranks is None:
+            ranks = self._ranks("standard")
         indeg = {n.id: len(n.parents) for n in dag.nodes}
         dev_free: Dict[int, float] = {}
         for n in dag.nodes:
@@ -263,10 +367,11 @@ class TaskScheduler:
             return n.task_type == TaskType.COMPUTE and "fwd" in n.name
 
         def priority(n: TaskNode) -> Tuple:
-            # Among startable tasks: lower micro first, backward before
-            # forward (drain beats fill at equal micro), stable by id.
-            bwd_bonus = 0 if is_bwd(n) else 1
-            return (n.micro if n.micro >= 0 else 0, bwd_bonus, n.id)
+            # Among startable tasks: lower policy rank first (standard:
+            # micro asc, backward before forward — drain beats fill at
+            # equal micro), stable by id. Ranks come from _ranks() so the
+            # native core orders identically.
+            return (ranks[n.id], n.id)
 
         # ready: dep-satisfied, unstarted tasks as a PRIORITY HEAP. A popped
         # task that cannot start yet is PARKED on the resource blocking it
@@ -301,17 +406,23 @@ class TaskScheduler:
                     win_parked.setdefault(n.stage, []).append((pr, tid))
                     continue        # 1F1B gate: stage window full
                 dur = self.task_time(n)
+                occ = self.occupancy_time(n)
                 start[tid] = t_now
                 fin = t_now + dur
                 order.append(tid)
                 per_device.setdefault(tuple(n.device_group), []).append(tid)
                 for d in n.device_group:
-                    dev_free[d] = fin
+                    dev_free[d] = t_now + occ
                     sim_busy[d] = sim_busy.get(d, 0.0) + (
-                        dur if n.task_type == TaskType.COMPUTE else 0.0)
+                        dur if n.task_type in _BUSY_TYPES else 0.0)
                 if is_fwd(n):
                     inflight.setdefault(n.stage, set()).add(n.micro)
                 heapq.heappush(events, (fin, tid))
+                if occ < dur:
+                    # Async transport: the device frees before the wire
+                    # latency elapses — a sentinel wake event lets parked
+                    # work start at the release instant.
+                    heapq.heappush(events, (t_now + occ, -1))
 
         while len(order) < len(dag.nodes):
             drain_ready()
@@ -324,6 +435,8 @@ class TaskScheduler:
             while events and events[0][0] == t_now:
                 finished.append(heapq.heappop(events)[1])
             for tid in finished:
+                if tid < 0:
+                    continue        # sentinel: device-release wake only
                 n = dag.node(tid)
                 task_finish[tid] = t_now
                 if is_bwd(n):
@@ -335,10 +448,12 @@ class TaskScheduler:
                     if indeg[c] == 0:
                         heapq.heappush(ready,
                                        (priority(dag.node(c)), c))
-                for d in n.device_group:
-                    if dev_free[d] <= t_now:
-                        for item in dev_parked.pop(d, []):
-                            heapq.heappush(ready, item)
+            # Wake parked work on every device free at this instant (a
+            # task finish or an async-transport occupancy release).
+            for d in list(dev_parked):
+                if dev_free[d] <= t_now:
+                    for item in dev_parked.pop(d, []):
+                        heapq.heappush(ready, item)
 
         makespan = max(task_finish.values(), default=0.0)
         peak = self._memory_account(order)

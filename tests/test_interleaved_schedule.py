@@ -1,0 +1,117 @@
+"""Interleaved-1F1B schedule quality (VERDICT r4 #5).
+
+The scheduler's candidate search now includes a Megatron
+chunk-alternating priority policy for interleaved placements (stage
+s -> group s % G), the stage ILP balances cuts through a bottleneck-stage
+objective term, and transport tasks model async DMA (device pays the
+launch alpha; the wire latency gates the consumer). Together these
+realize the interleaved-1F1B bubble gain in simulation — in the regime
+the technique exists for: warmup-dominated pipelines (deep p, modest M)
+with hops cheap relative to stage compute (real ICI/DCN).
+
+Reference: pjrt/task_scheduler.{h,cc} GROUP_SCHED_COUNT candidates +
+ReorderSend/Recv/GA post-passes; Megatron-LM interleaved schedules.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tepdist_tpu.core.service_env import ServiceEnv
+from tepdist_tpu.parallel.pipeline import plan_pipeline
+from tepdist_tpu.runtime.execution_plan import build_pipeline_task_dag
+from tepdist_tpu.runtime.task_scheduler import TaskScheduler
+
+
+def _deep_mlp(depth=16, width=512, batch=16384):
+    def loss(params, x, y):
+        h = x
+        for i in range(depth):
+            h = jax.nn.relu(h @ params[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    params = {f"w{i}": jax.ShapeDtypeStruct((width, width), jnp.float32)
+              for i in range(depth)}
+    x = jax.ShapeDtypeStruct((batch, width), jnp.float32)
+    y = jax.ShapeDtypeStruct((batch, width), jnp.float32)
+    return loss, params, x, y
+
+
+def test_stage_ilp_balances_uniform_chain():
+    """The bottleneck-objective ILP cuts a uniform 16-layer chain into
+    near-equal stages at S=4 (the pre-r5 solver legally parked 11 layers
+    in one stage: on a chain the traffic term is cut-location-invariant
+    and UNBALANCED_RATIO=8 allowed it)."""
+    loss, params, x, y = _deep_mlp(batch=2048)
+    prog = plan_pipeline(loss, 4, 2, params, x, y)
+    fl = prog.stage_flops()
+    imbalance = max(fl) / (sum(fl) / len(fl))
+    assert imbalance <= 1.25, fl
+
+
+def test_interleaved_realizes_megatron_bubble_gain():
+    """At p=8 groups, M=8 micros (warmup-dominated — bubble ~(p-1)/(m+p-1)
+    blocked), running 16 virtual stages interleaved over the same 8
+    groups cuts BOTH the simulated makespan and the bubble ratio vs the
+    blocked 8-stage layout, and the Megatron chunk-alternating priority
+    is what the candidate search selects."""
+    loss, params, x, y = _deep_mlp()
+    M = 8
+    try:
+        # Hops cheap relative to stage compute (the ICI/DCN regime the
+        # technique targets; the CPU-mesh default DCN constant would make
+        # this transport-bound and measure the wire, not the schedule).
+        ServiceEnv.reset({"PP_BANDWIDTH": 50000.0})
+        prog16 = plan_pipeline(loss, 16, M, params, x, y)
+        dag_i, _ = build_pipeline_task_dag(
+            prog16, [(s % 8,) for s in range(16)])
+        prog8 = plan_pipeline(loss, 8, M, params, x, y)
+        dag_b, _ = build_pipeline_task_dag(
+            prog8, [(s,) for s in range(8)])
+
+        ts_i = TaskScheduler(dag_i)
+        ts_b = TaskScheduler(dag_b)
+        # Same window for both (same in-flight memory class).
+        w = 8
+        r_meg = ts_i._simulate(w, policy="interleaved")
+        r_std = ts_i._simulate(w, policy="standard")
+        r_blk = ts_b._simulate(w)
+
+        # The interleaved placement beats blocked on both axes.
+        assert r_meg.makespan < r_blk.makespan, (
+            r_meg.makespan, r_blk.makespan)
+        assert r_meg.bubble_ratio < r_blk.bubble_ratio, (
+            r_meg.bubble_ratio, r_blk.bubble_ratio)
+        # The chunk-alternating policy competes: at the memory-favored
+        # narrower window it strictly beats the standard priority on the
+        # SAME DAG (at wide windows they converge).
+        r_meg4 = ts_i._simulate(4, policy="interleaved")
+        r_std4 = ts_i._simulate(4, policy="standard")
+        assert r_meg4.makespan < r_std4.makespan, (
+            r_meg4.makespan, r_std4.makespan)
+        # And schedule() surfaces an interleaved-DAG winner at least as
+        # good as every standard-policy candidate it tried.
+        best = ts_i.schedule()
+        assert best.makespan <= min(r_std.makespan, r_std4.makespan)
+    finally:
+        ServiceEnv.reset()
+
+
+def test_async_transport_occupancy():
+    """SEND/RECV hold the device only for the launch alpha; the wire
+    latency still gates the consumer (async DMA — reference
+    ASYNC_SEND/ASYNC_RECV posture, service_env.h:46-47)."""
+    from tepdist_tpu.runtime.task_graph import TaskType
+
+    loss, params, x, y = _deep_mlp(depth=4, batch=2048)
+    prog = plan_pipeline(loss, 2, 2, params, x, y)
+    dag, _ = build_pipeline_task_dag(prog, [(0,), (1,)])
+    ts = TaskScheduler(dag)
+    send = next(n for n in dag.nodes if n.task_type == TaskType.SEND)
+    assert ts.occupancy_time(send) <= ts.task_time(send)
+    r = ts._simulate(2)
+    # The consumer RECV's children never start before the send's full
+    # wire time has elapsed.
+    recv = next(c for c in send.children
+                if dag.node(c).task_type == TaskType.RECV)
+    assert r.start[recv] >= r.start[send.id] + ts.task_time(send) - 1e-12

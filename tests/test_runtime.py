@@ -56,7 +56,10 @@ def test_dag_structure(prog):
 def test_schedule_is_1f1b_like(prog):
     p, *_ = prog
     dag, maps = build_pipeline_task_dag(p, [(0, 1, 2, 3), (4, 5, 6, 7)])
-    sched = TaskScheduler(dag, micro_num_limit=1).schedule()
+    # Pin the window to 1: schedule() may legitimately pick a wider
+    # candidate window when memory allows (GROUP_SCHED_COUNT sweep); the
+    # property under test is that the window GATE produces 1F1B order.
+    sched = TaskScheduler(dag, micro_num_limit=1)._simulate(1)
     assert len(sched.order) == len(dag.nodes)
     # With window=1 on stage 0: bwd of micro m must start before fwd of
     # micro m+2 (the 1F1B property).
@@ -408,10 +411,9 @@ def test_interleaved_placement_matches_blocked(devices):
     """Interleaved virtual stages (stage s -> group s % G): 4 planned
     stages run on 2 device groups (the multiworker s %% W layout,
     in-process) with numerics equal to the sequential reference.
-    NOTE: the event-driven greedy scheduler does not (yet) realize the
-    Megatron interleaved-1F1B bubble gain — measured in sim and recorded
-    in NOTES_NEXT; the placement's standalone value is running MORE
-    stages than device groups with co-resident passthrough hops."""
+    The scheduler realizes the Megatron interleaved-1F1B bubble gain in
+    the warmup-dominated regime (tests/test_interleaved_schedule.py);
+    this test pins the NUMERICS contract of the placement."""
     loss_fn, params, x, y = _mlp4()
     tx = optax.sgd(0.1)
 
