@@ -175,6 +175,9 @@ class TaskScheduler:
 
         standard: (micro, bwd-before-fwd) — classic 1F1B drain-over-fill.
 
+        Cached per policy (schedule() simulates every (policy, window)
+        candidate; ranks depend only on the policy).
+
         interleaved (reference: the Megatron interleaved-1F1B order the
         reference approximates with Reorder post-passes,
         task_scheduler.h:347-374): each device holds v model chunks
@@ -182,6 +185,11 @@ class TaskScheduler:
         round a device runs chunk 0's G forwards before chunk 1's — the
         virtual micro index vm = (m//G)*v*G + chunk*G + m%G linearizes
         that order, with backwards draining chunks in reverse."""
+        cache = getattr(self, "_rank_cache", None)
+        if cache is None:
+            cache = self._rank_cache = {}
+        if policy in cache:
+            return cache[policy]
         factors = self._interleave_factors()
         ranks: List[int] = []
         for n in self.dag.nodes:
@@ -195,6 +203,7 @@ class TaskScheduler:
             cc = (v - 1 - c) if bwd else c
             vm = (m // G) * v * G + cc * G + (m % G)
             ranks.append(vm * 2 + (0 if bwd else 1))
+        cache[policy] = ranks
         return ranks
 
     def _policies(self) -> List[str]:
