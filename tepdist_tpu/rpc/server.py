@@ -94,7 +94,7 @@ class _CompiledPipelinePlan:
     kind = "pipeline"
 
     def __init__(self, exe, optimizer, n_params, n_state, n_invars,
-                 strategies_summary):
+                 strategies_summary, is_fleet: bool = False):
         self.exe = exe
         self.optimizer = optimizer
         self.n_params = n_params
@@ -107,6 +107,12 @@ class _CompiledPipelinePlan:
         self.shardings = None
         self.loaded = False
         self.retired = False
+        # Fleet-dispatched winners run a DistributedPipelineSession over
+        # the registered worker cluster instead of an in-process
+        # executable; optimizer slots then live WORKER-side (their
+        # checkpoints flow through DoRemoteSave/Restore on the workers,
+        # not the master's store).
+        self.is_fleet = is_fleet
 
     def load_from_store(self, variables, with_opt_state: bool):
         """Pull params (and optionally optimizer slots) from the servicer's
@@ -120,7 +126,7 @@ class _CompiledPipelinePlan:
                 "transferred nor initialized")
         params = [variables[i] for i in range(self.n_params)]
         self.exe.load_variables(params)   # re-inits per-stage opt states
-        if with_opt_state:
+        if with_opt_state and not self.is_fleet:
             opt_sds = _jax.eval_shape(self.optimizer.init, params)
             tree = _jax.tree_util.tree_structure(opt_sds)
             leaves = [variables[i]
@@ -129,17 +135,22 @@ class _CompiledPipelinePlan:
                 _jax.tree_util.tree_unflatten(tree, leaves))
         self.loaded = True
 
-    def sync_to_store(self, variables):
-        """Write the runtime's current state back into the variable store
-        (FetchResourceVars / checkpoint reads go through the store)."""
+    def state_leaves(self):
+        """The runtime's current state as flat store-ordered leaves.
+        Fleet plans return params only — optimizer slots live worker-side
+        and checkpoint through DoRemoteSave on the workers. MAY MAKE
+        RPCs (fleet fetch, including a loopback to the master): callers
+        must NOT hold the servicer's store lock."""
         import jax as _jax
 
         if not self.loaded:
-            return
+            return None
         flat = list(_jax.tree_util.tree_leaves(self.exe.fetch_variables()))
-        flat += list(_jax.tree_util.tree_leaves(self.exe.fetch_opt_state()))
-        for i, leaf in enumerate(flat):
-            variables[i] = leaf
+        if not self.is_fleet:
+            flat += list(_jax.tree_util.tree_leaves(
+                self.exe.fetch_opt_state()))
+        return flat
+
 
 
 class TepdistServicer:
@@ -205,13 +216,34 @@ class TepdistServicer:
         """Flush the live pipeline runtime's state into the variable store
         before ANY store read (fetch / save / an SPMD plan resolving
         variable args). Takes _exec_lock so the sync cannot observe a
-        torn mid-step state, then _lock for the store write."""
+        torn mid-step state; the state FETCH runs outside the store lock
+        (a fleet-dispatched runtime fetches over RPC, including a
+        loopback into this server — holding _lock there deadlocks the
+        handler, and the loopback FetchResourceVars must NOT recurse
+        into this sync: the _pipeline_syncing guard makes it serve the
+        raw store instead, which the master's worker role keeps
+        current)."""
         ap = getattr(self, "_active_pipeline", None)
         if ap is None:
             return
+        if ap.is_fleet and getattr(self, "_pipeline_syncing", False):
+            # The sync's own loopback FetchResourceVars: serve the raw
+            # store (the master's worker role keeps its shards current).
+            # Only fleet plans make loopbacks; a concurrent EXTERNAL
+            # reader landing in this window gets the last completed
+            # sync's view — bounded staleness, fleet-only. In-process
+            # plans keep full lock-serialized freshness below.
+            return
         with self._exec_lock:
-            with self._lock:
-                ap.sync_to_store(self.variables)
+            self._pipeline_syncing = True
+            try:
+                flat = ap.state_leaves()
+                if flat is not None:
+                    with self._lock:
+                        for i, leaf in enumerate(flat):
+                            self.variables[i] = leaf
+            finally:
+                self._pipeline_syncing = False
 
     def _retire_active_pipeline(self) -> None:
         """A new STATE-WRITING plan supersedes the live pipeline runtime:
@@ -444,8 +476,6 @@ class TepdistServicer:
         # constants are correct for.
         prog = plan_pipeline(best["_micro_loss_fn"], S, M, params_sds,
                              *batch_sds)
-        exe = PipelineExecutable(prog, devices=self.devices,
-                                 optimizer=optimizer, intra_stage_tp=tp)
         summary = {
             "axes": [["stage", S]] + ([["model", tp]] if tp > 1 else []),
             "mode": "explore",
@@ -456,8 +486,42 @@ class TepdistServicer:
             "planner_seconds": round(time.time() - t0, 3),
             "explored": explored,
         }
+        # Fleet dispatch (reference: the service compiles the PIPELINE
+        # plan into per-worker def-modules and drives the worker fleet,
+        # virtual_client.cc:776 + execution_coordinator): when a cluster
+        # spec with peers is registered (InitMeshTopology), the winner
+        # runs a DistributedPipelineSession over the WORKERS — the master
+        # included, via loopback — instead of an in-process executable.
+        cluster_workers = (getattr(self, "cluster_spec", None)
+                           or {}).get("workers", [])
+        is_fleet = len(cluster_workers) >= 2
+        if is_fleet:
+            from tepdist_tpu.core.cluster_spec import (
+                ClusterSpec,
+                WorkerSpec,
+            )
+            from tepdist_tpu.runtime.distributed_executor import (
+                DistributedPipelineSession,
+            )
+
+            cluster = ClusterSpec([
+                WorkerSpec(w["ip"], int(w["port"]),
+                           list(w.get("device_ids", [0])),
+                           task_index=int(w["task_index"]))
+                for w in cluster_workers])
+            exe = DistributedPipelineSession(prog, cluster,
+                                             optimizer=optimizer)
+            summary["fleet_workers"] = cluster.num_workers
+            # The fleet layout is one device group per worker; the
+            # priced intra-stage TP does not apply across it.
+            summary["intra_tp_applied"] = 1
+        else:
+            exe = PipelineExecutable(prog, devices=self.devices,
+                                     optimizer=optimizer,
+                                     intra_stage_tp=tp)
         plan = _CompiledPipelinePlan(exe, optimizer, n_params, n_state,
-                                     n_state + len(batch_sds), summary)
+                                     n_state + len(batch_sds), summary,
+                                     is_fleet=is_fleet)
         handle = self.plan_cache.insert(plan)
         # The store's state reads (FetchResourceVars / checkpoints) must
         # see this runtime's live state once it loads.
@@ -696,11 +760,16 @@ class TepdistServicer:
                 batch_vals.append(val)
         with self._exec_lock:
             if not plan.loaded:
+                # Snapshot under the store lock, then load WITHOUT it: a
+                # fleet runtime's load_variables pushes over RPC,
+                # including a loopback into this server's
+                # TransferToServerHost (which takes the store lock).
                 with self._lock:
-                    plan.load_from_store(
-                        self.variables,
-                        with_opt_state=getattr(
-                            self, "_pipeline_restored", False))
+                    snapshot = dict(self.variables)
+                plan.load_from_store(
+                    snapshot,
+                    with_opt_state=getattr(
+                        self, "_pipeline_restored", False))
                 self._pipeline_restored = False
             loss = plan.exe.step(*batch_vals)
             if not header.get("inference"):
@@ -935,6 +1004,19 @@ class TepdistServicer:
 
     def _do_save(self, opts) -> None:
         from tepdist_tpu.runtime.checkpoint import CheckpointUtil
+        # Fleet-dispatched pipeline winner: the checkpoint is the
+        # WORKERS' (per-worker shards + per-stage optimizer slots) — fan
+        # DoRemoteSave out over the fleet (the master included, whose
+        # loopback handler takes the local path below via the guard).
+        ap = getattr(self, "_active_pipeline", None)
+        if (ap is not None and ap.is_fleet and ap.loaded
+                and not getattr(self, "_fleet_ckpt", False)):
+            self._fleet_ckpt = True
+            try:
+                ap.exe.save(max_to_keep=opts.get("max_to_keep", 5))
+            finally:
+                self._fleet_ckpt = False
+            return
         self._sync_active_pipeline()
         with self._lock:
             # Values pass through as-is: CheckpointUtil writes only this
@@ -959,6 +1041,20 @@ class TepdistServicer:
 
     def _do_restore(self, opts) -> None:
         from tepdist_tpu.runtime.checkpoint import CheckpointUtil
+        # Fleet restore mirrors the fleet save: fan DoRemoteRestore over
+        # the workers (each restores its shards + optimizer slots); the
+        # runtime then already HOLDS the restored state — no reload from
+        # the master's store (which would clobber it with stale params).
+        ap = getattr(self, "_active_pipeline", None)
+        if (ap is not None and ap.is_fleet and ap.loaded
+                and not getattr(self, "_fleet_ckpt", False)):
+            self._fleet_ckpt = True
+            try:
+                ap.exe.restore(int(opts.get("global_step", -1)))
+            finally:
+                self._fleet_ckpt = False
+            self._sync_active_pipeline()   # refresh the store's params
+            return
         util = CheckpointUtil(self.ckpt_dir)
         if opts.get("all_shards"):
             # Elastic re-dispatch: this worker may have adopted stages a
@@ -980,10 +1076,12 @@ class TepdistServicer:
                     stage: [slots[j] for j in sorted(slots)]
                     for stage, slots in opt_states.items()}
             self.global_step = step
-        # A live pipeline runtime must reload the restored state (params
-        # AND optimizer slots) before its next step.
+        # A live IN-PROCESS pipeline runtime must reload the restored
+        # state (params AND optimizer slots) before its next step. A
+        # fleet runtime restored above (or via its master-as-worker
+        # loopback, _fleet_ckpt set) already holds the restored state.
         ap = getattr(self, "_active_pipeline", None)
-        if ap is not None:
+        if ap is not None and not ap.is_fleet:
             ap.loaded = False
             self._pipeline_restored = True
 
@@ -1006,7 +1104,7 @@ class TepdistServicer:
 
 
 def create_server(port: int, devices=None, task_index: int = 0,
-                  max_workers: int = 8):
+                  max_workers: int = 32):
     """Real gRPC server over generic (bytes-in/bytes-out) handlers."""
     import grpc
 

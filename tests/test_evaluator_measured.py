@@ -224,8 +224,12 @@ def test_explore_candidate_ranking_vs_measured(devices, n_devices, tol,
         f"n={n_devices}: evaluator picked {eval_best}; "
         f"eval={ {k: round(v, 6) for k, v in evals.items()} } "
         f"meas={ {k: round(v * 1e3, 1) for k, v in meas.items()} }")
-    # The analytic costs must discriminate across the candidate kinds.
-    assert max(evals.values()) / min(evals.values()) >= 1.1
+    # The analytic costs must discriminate across the candidate kinds
+    # (the r2 degenerate state priced ALL candidates identically). The
+    # bar is non-collapse, not a fixed spread: r5's balanced stage cuts +
+    # async transport model legitimately pulled the pipeline candidate
+    # within ~8% of dp at n=2.
+    assert max(evals.values()) / min(evals.values()) >= 1.02
 
 
 def test_cross_axis_conflict_priced_and_loses(devices):

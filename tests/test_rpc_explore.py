@@ -35,7 +35,7 @@ def _free_port() -> int:
     return port
 
 
-def _spawn_server(extra_env=None):
+def _spawn_server(extra_env=None, task_index=0):
     port = _free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -45,7 +45,8 @@ def _spawn_server(extra_env=None):
     env.update(extra_env or {})
     proc = subprocess.Popen(
         [sys.executable, "-m", "tepdist_tpu.rpc.server",
-         "--port", str(port), "--platform", "cpu"],
+         "--port", str(port), "--platform", "cpu",
+         "--task_index", str(task_index)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
@@ -188,3 +189,61 @@ def test_explicit_mesh_axes_skip_exploration():
         _kill(proc)
     ref_losses, _ = _local_sgd_trajectory(loss_fn, params, x, y, 0.1, 2)
     np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+
+
+def test_pipeline_winner_dispatches_over_worker_fleet():
+    """When the master has a registered worker cluster (InitMeshTopology)
+    and the exploration picks a pipeline stage cut, BuildExecutionPlan
+    dispatches the winner over the FLEET (DistributedPipelineSession:
+    per-worker stage modules, raw-data activation hops) — the reference's
+    service-compiled pipeline driving its workers
+    (virtual_client.cc:776). The no-topology client trains through the
+    master transparently."""
+    from tepdist_tpu.rpc import protocol
+
+    loss_fn, params, x, y = _mlp(depth=8, width=512, batch=16)
+    ckpt_dir = tempfile.mkdtemp(prefix="tepdist_fleet_ckpt_")
+    fleet_env = dict(_PIPELINE_ENV, TEPDIST_CKPT_DIR=ckpt_dir)
+    m_port, m_proc = _spawn_server(fleet_env, task_index=0)
+    s_port, s_proc = _spawn_server(fleet_env, task_index=1)
+    try:
+        # Register the 2-worker cluster on the MASTER (worker 0 = the
+        # master itself, reached over loopback).
+        mc = TepdistClient(f"127.0.0.1:{m_port}")
+        mc.stub.call("InitMeshTopology", protocol.pack({
+            "cluster_spec": {"workers": [
+                {"ip": "127.0.0.1", "port": m_port, "device_ids": [0],
+                 "task_index": 0},
+                {"ip": "127.0.0.1", "port": s_port, "device_ids": [0],
+                 "task_index": 1},
+            ]}}))
+        mc.close()
+        sess = TepdistSession(f"127.0.0.1:{m_port}", mesh_axes=())
+        summary = sess.compile_training(
+            loss_fn, optax.sgd(0.01), params, x, y,
+            num_micro_batches=4,
+            optimizer_spec=optimizer_spec("sgd", learning_rate=0.01))
+        assert summary.get("kind") == "pipeline", summary
+        assert summary.get("fleet_workers") == 2, summary
+        rpc_losses = [sess.run(x, y) for _ in range(3)]
+        fetched_params = sess.params()
+        # Fleet checkpoints fan out over the workers (per-worker shards
+        # + per-stage optimizer slots): save, advance, restore, and the
+        # post-restore trajectory must REPLAY the post-save one.
+        sess.save()
+        after_save = [sess.run(x, y) for _ in range(2)]
+        sess.restore()
+        replayed = [sess.run(x, y) for _ in range(2)]
+        np.testing.assert_allclose(replayed, after_save, rtol=1e-4)
+        sess.close()
+    finally:
+        _kill(m_proc)
+        _kill(s_proc)
+
+    ref_losses, ref_params = _local_sgd_trajectory(
+        loss_fn, params, x, y, 0.01, 3)
+    np.testing.assert_allclose(rpc_losses, ref_losses, rtol=1e-4)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(fetched_params[k]), np.asarray(ref_params[k]),
+            rtol=1e-4, atol=1e-6)
