@@ -46,4 +46,8 @@ echo "== gpt_moe =="
 python examples/gpt_moe/pretrain_gpt_moe.py --config test --batch 4 \
     --seq 32 --steps 2
 
+echo "== auto_explore (fully automatic service-side planning) =="
+python examples/auto_explore/main.py --steps 2
+python examples/auto_explore/main.py --steps 2 --regime pipeline
+
 echo "ALL EXAMPLES OK"
