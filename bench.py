@@ -39,11 +39,15 @@ HEADLINE_CACHE = os.path.join(HERE, "bench_headline_tpu.json")
 V5E_PEAK_FLOPS = 197e12  # bf16
 
 
-def _vs_baseline(metric: str, value: float, extra: dict | None = None
-                 ) -> float:
-    """Ratio against the stored baseline; first run records it. A corrupt
-    baseline file is never overwritten (other metrics' baselines would be
-    lost) — the current value just serves as its own baseline."""
+def _vs_baseline(metric: str, value: float, extra: dict | None = None,
+                 record_extra: bool = True) -> float:
+    """Ratio against the stored baseline; first run records it (plus the
+    ``extra`` reference keys). For EXISTING metric baselines, missing
+    extra keys are backfilled (e.g. the canary reference added after the
+    metric's first recording) — unless ``record_extra`` is False (a
+    flagged-noisy run must not poison a reference). A corrupt baseline
+    file is never overwritten (other metrics' baselines would be lost) —
+    the current value just serves as its own baseline."""
     data = {}
     if os.path.exists(BASELINE_FILE):
         try:
@@ -51,10 +55,17 @@ def _vs_baseline(metric: str, value: float, extra: dict | None = None
         except Exception:
             return 1.0
     baseline = data.get(metric)
+    dirty = False
     if baseline is None:
         data[metric] = value
+        dirty = True
+        baseline = value
+    if record_extra:
         for k, v in (extra or {}).items():
-            data[f"{metric}_{k}"] = v
+            if f"{metric}_{k}" not in data:
+                data[f"{metric}_{k}"] = v
+                dirty = True
+    if dirty:
         try:
             tmp = f"{BASELINE_FILE}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
@@ -62,7 +73,6 @@ def _vs_baseline(metric: str, value: float, extra: dict | None = None
             os.replace(tmp, BASELINE_FILE)
         except Exception:
             pass
-        baseline = value
     return value / baseline
 
 
@@ -93,19 +103,69 @@ def _timed_windows(step, flat, thread_state, steps: int, windows: int = 5
 # Above this window dispersion the run carries no regression verdict:
 # vs_baseline is withheld (null) rather than reported from noise.
 SPREAD_VERDICT_LIMIT = 0.10
+# A UNIFORMLY slowed host (competing process through the whole run) shows
+# LOW spread with a depressed median — the canary below catches it: a
+# fixed numpy workload timed alongside the benchmark, compared to its
+# own recorded baseline.
+CANARY_SLOWDOWN_LIMIT = 1.3
+
+
+def _host_canary_ms() -> float:
+    """Median time of a fixed CPU workload (pure numpy, no jax): the
+    host-speed reference the throughput verdicts are conditioned on."""
+    import numpy as np
+
+    a = np.random.default_rng(0).standard_normal((384, 384),
+                                                 dtype=np.float32)
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        b = a
+        for _ in range(12):
+            b = b @ a
+            b *= 1.0 / np.abs(b).max()
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2] * 1e3
 
 
 def _verdict_fields(metric: str, value: float, spread: float,
                     extra: dict | None = None) -> dict:
-    """vs_baseline + dispersion fields, refusing a verdict on noisy runs."""
-    ratio = _vs_baseline(metric, value, extra)
-    out = {"spread": round(spread, 4)}
-    if spread > SPREAD_VERDICT_LIMIT:
+    """vs_baseline + dispersion fields, refusing a verdict on noisy or
+    host-speed-drifted runs (spread guard + symmetric canary guard)."""
+    canary = _host_canary_ms()
+    extra = dict(extra or {})
+    extra["canary_ms"] = canary
+    spread_bad = spread > SPREAD_VERDICT_LIMIT
+    # A flagged-noisy run must not seed/backfill reference values.
+    ratio = _vs_baseline(metric, value, extra,
+                         record_extra=not spread_bad)
+    out = {"spread": round(spread, 4), "host_canary_ms": round(canary, 2)}
+    canary_base = None
+    try:
+        canary_base = json.load(open(BASELINE_FILE)).get(
+            f"{metric}_canary_ms")
+    except Exception:  # noqa: BLE001
+        pass
+    # Symmetric: a slowed host makes phantom regressions, a faster host
+    # (or a reference recorded under load) makes phantom improvements —
+    # neither run carries a throughput verdict.
+    drift = (canary / canary_base
+             if canary_base is not None and canary_base > 0 else 1.0)
+    drift_bad = (drift > CANARY_SLOWDOWN_LIMIT
+                 or drift < 1.0 / CANARY_SLOWDOWN_LIMIT)
+    if spread_bad or drift_bad:
         out["vs_baseline"] = None
         out["vs_baseline_raw"] = round(ratio, 4)
-        out["verdict_note"] = (
-            f"window spread {spread:.1%} > {SPREAD_VERDICT_LIMIT:.0%}: "
-            "noisy host, no regression verdict")
+        reasons = []
+        if spread_bad:
+            reasons.append(
+                f"window spread {spread:.1%} > {SPREAD_VERDICT_LIMIT:.0%}")
+        if drift_bad:
+            reasons.append(f"host canary {drift:.2f}x its baseline")
+        out["verdict_note"] = ("; ".join(reasons)
+                               + ": noisy/loaded host, no regression "
+                                 "verdict")
     else:
         out["vs_baseline"] = round(ratio, 4)
     return out
