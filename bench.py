@@ -39,28 +39,37 @@ HEADLINE_CACHE = os.path.join(HERE, "bench_headline_tpu.json")
 V5E_PEAK_FLOPS = 197e12  # bf16
 
 
+def _read_baselines() -> dict:
+    """Parse the baseline file once; {} when absent/corrupt (a corrupt
+    file is never overwritten — other metrics' baselines would be
+    lost)."""
+    if not os.path.exists(BASELINE_FILE):
+        return {}
+    try:
+        return json.load(open(BASELINE_FILE))
+    except Exception:  # noqa: BLE001
+        return {"_corrupt": True}
+
+
 def _vs_baseline(metric: str, value: float, extra: dict | None = None,
-                 record_extra: bool = True) -> float:
-    """Ratio against the stored baseline; first run records it (plus the
-    ``extra`` reference keys). For EXISTING metric baselines, missing
-    extra keys are backfilled (e.g. the canary reference added after the
-    metric's first recording) — unless ``record_extra`` is False (a
-    flagged-noisy run must not poison a reference). A corrupt baseline
-    file is never overwritten (other metrics' baselines would be lost) —
-    the current value just serves as its own baseline."""
-    data = {}
-    if os.path.exists(BASELINE_FILE):
-        try:
-            data = json.load(open(BASELINE_FILE))
-        except Exception:
-            return 1.0
+                 record: bool = True, data: dict | None = None) -> float:
+    """Ratio against the stored baseline. ``record=True`` lets a first
+    run seed the metric baseline and backfill missing ``extra``
+    reference keys (e.g. the host canary) for existing metrics; a
+    flagged run (noisy/loaded host) passes ``record=False`` so it can
+    never poison a reference — neither the primary baseline nor the
+    extras. ``data``: pre-parsed baseline contents (single read)."""
+    data = dict(_read_baselines() if data is None else data)
+    if data.pop("_corrupt", None):
+        return 1.0
     baseline = data.get(metric)
     dirty = False
     if baseline is None:
-        data[metric] = value
-        dirty = True
         baseline = value
-    if record_extra:
+        if record:
+            data[metric] = value
+            dirty = True
+    if record:
         for k, v in (extra or {}).items():
             if f"{metric}_{k}" not in data:
                 data[f"{metric}_{k}"] = v
@@ -137,16 +146,11 @@ def _verdict_fields(metric: str, value: float, spread: float,
     extra = dict(extra or {})
     extra["canary_ms"] = canary
     spread_bad = spread > SPREAD_VERDICT_LIMIT
-    # A flagged-noisy run must not seed/backfill reference values.
-    ratio = _vs_baseline(metric, value, extra,
-                         record_extra=not spread_bad)
-    out = {"spread": round(spread, 4), "host_canary_ms": round(canary, 2)}
-    canary_base = None
-    try:
-        canary_base = json.load(open(BASELINE_FILE)).get(
-            f"{metric}_canary_ms")
-    except Exception:  # noqa: BLE001
-        pass
+    # Drift is judged BEFORE any baseline write, from one parse of the
+    # file — a loaded host must not backfill its own canary reference
+    # and then self-approve against it.
+    data = _read_baselines()
+    canary_base = data.get(f"{metric}_canary_ms")
     # Symmetric: a slowed host makes phantom regressions, a faster host
     # (or a reference recorded under load) makes phantom improvements —
     # neither run carries a throughput verdict.
@@ -154,6 +158,11 @@ def _verdict_fields(metric: str, value: float, spread: float,
              if canary_base is not None and canary_base > 0 else 1.0)
     drift_bad = (drift > CANARY_SLOWDOWN_LIMIT
                  or drift < 1.0 / CANARY_SLOWDOWN_LIMIT)
+    # A flagged run records NOTHING (neither a first-run metric baseline
+    # nor reference backfills).
+    ratio = _vs_baseline(metric, value, extra,
+                         record=not (spread_bad or drift_bad), data=data)
+    out = {"spread": round(spread, 4), "host_canary_ms": round(canary, 2)}
     if spread_bad or drift_bad:
         out["vs_baseline"] = None
         out["vs_baseline_raw"] = round(ratio, 4)

@@ -247,3 +247,62 @@ def test_pipeline_winner_dispatches_over_worker_fleet():
         np.testing.assert_allclose(
             np.asarray(fetched_params[k]), np.asarray(ref_params[k]),
             rtol=1e-4, atol=1e-6)
+
+
+def test_generate_reads_live_pipeline_weights():
+    """compile_generate AFTER a pipeline-winner training: the generate
+    plan is a read-only SPMD plan — it must see the pipeline runtime's
+    LIVE weights (the sync-before-read invariant), not the store's
+    initial copies, and stepping the training plan afterwards still
+    works (read-only plans do not retire the runtime)."""
+    loss_fn, params, x, y = _mlp(depth=8, width=512, batch=16)
+    port, proc = _spawn_server(_PIPELINE_ENV)
+    try:
+        sess = TepdistSession(f"127.0.0.1:{port}", mesh_axes=())
+        summary = sess.compile_training(
+            loss_fn, optax.sgd(0.01), params, x, y,
+            num_micro_batches=4,
+            optimizer_spec=optimizer_spec("sgd", learning_rate=0.01))
+        assert summary.get("kind") == "pipeline", summary
+        losses = [sess.run(x, y) for _ in range(3)]
+
+        def fwd(p, xx):
+            h = xx
+            for i in range(8):
+                h = jax.nn.relu(h @ p[f"w{i}"])
+            return h
+
+        sess.compile_generate(fwd, params, x)
+        out = sess.generate(x)
+        # Training continues after the read-only plan compiled.
+        more = sess.run(x, y)
+        assert more < losses[-1]
+        sess.close()
+    finally:
+        _kill(proc)
+
+    _, ref_params = _local_sgd_trajectory(loss_fn, params, x, y, 0.01, 3)
+    ref_out = np.asarray(jax.jit(lambda p, xx: fwd(p, xx))(ref_params, x))
+    np.testing.assert_allclose(np.asarray(out), ref_out, rtol=1e-3,
+                               atol=1e-5)
+
+
+def test_explore_without_optimizer_spec_records_exclusions():
+    """No optimizer_spec: the server cannot materialize pipeline/seq
+    winners, so those kinds are EXCLUDED from the search — and the
+    exclusion is recorded in the summary, never silent."""
+    loss_fn, params, x, y = _mlp()
+    port, proc = _spawn_server()
+    try:
+        sess = TepdistSession(f"127.0.0.1:{port}", mesh_axes=())
+        summary = sess.compile_training(
+            loss_fn, optax.sgd(0.1), params, x, y)
+        explored = summary["explored"]
+        assert set(explored.get("excluded_kinds", [])) == {"seq",
+                                                           "pipeline"}
+        assert "optimizer_spec" in explored.get("excluded_reason", "")
+        losses = [sess.run(x, y) for _ in range(2)]
+        assert losses[1] < losses[0]
+        sess.close()
+    finally:
+        _kill(proc)
