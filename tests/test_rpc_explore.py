@@ -306,3 +306,42 @@ def test_explore_without_optimizer_spec_records_exclusions():
         sess.close()
     finally:
         _kill(proc)
+
+
+def test_superseded_pipeline_handle_refuses_steps():
+    """A NEW state-writing plan retires the live pipeline runtime; the
+    old handle must REFUSE further steps (training through a detached
+    runtime would be invisible to every store reader), while the new
+    plan trains normally."""
+    loss_fn, params, x, y = _mlp(depth=8, width=512, batch=16)
+    port, proc = _spawn_server(_PIPELINE_ENV)
+    try:
+        sess = TepdistSession(f"127.0.0.1:{port}", mesh_axes=())
+        summary = sess.compile_training(
+            loss_fn, optax.sgd(0.01), params, x, y,
+            num_micro_batches=4,
+            optimizer_spec=optimizer_spec("sgd", learning_rate=0.01))
+        assert summary.get("kind") == "pipeline", summary
+        old_handle = sess.handle
+        first = sess.run(x, y)
+
+        # Recompile (state-writing) — retires the pipeline runtime (its
+        # trained state flushes to the store, then the new compile's
+        # OWN initial transfers overwrite it: a fresh training session).
+        sess2 = TepdistSession(f"127.0.0.1:{port}", mesh_axes=())
+        sess2.compile_training(
+            loss_fn, optax.sgd(0.01), params, x, y,
+            num_micro_batches=4,
+            optimizer_spec=optimizer_spec("sgd", learning_rate=0.01))
+        np.testing.assert_allclose(sess2.run(x, y), first, rtol=1e-5)
+
+        import grpc
+
+        with pytest.raises(grpc.RpcError, match="superseded"):
+            sess.client.execute_plan(
+                old_handle,
+                inline_args={8: np.asarray(x), 9: np.asarray(y)})
+        sess.close()
+        sess2.close()
+    finally:
+        _kill(proc)
