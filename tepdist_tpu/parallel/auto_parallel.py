@@ -68,6 +68,24 @@ class ParallelPlan:
                 donate_invars=key)
         return self._flat_cache[key]
 
+    def lowering_diagnostics(self, devices=None,
+                             donate_invars: Optional[Sequence[int]] = None
+                             ) -> List[str]:
+        """AOT-compile the plan and return the HLO ops XLA flagged with
+        'Involuntary full rematerialization' — the device-order pathology
+        no pre-lowering cost model can price (parallel/lowering_check.py).
+        [] == cleanly shardable. Compiles the SAME jit the trainer uses
+        (state-donating by default), so the diagnostic compile is cached
+        and the first real step pays nothing extra."""
+        from tepdist_tpu.parallel.lowering_check import involuntary_remats
+
+        if donate_invars is None:
+            donate_invars = self.state_donation()
+        fn = self.executable(devices=devices, donate_invars=donate_invars)
+        args = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
+                for v in self.graph.invars]
+        return involuntary_remats(fn, args)
+
     def state_donation(self) -> Tuple[int, ...]:
         """Invar indices safe to donate when the caller threads the aliased
         state (outputs replace these inputs): without donation the training

@@ -329,3 +329,49 @@ def test_cross_axis_conflict_priced_and_loses(devices):
             best = dt if best is None else min(best, dt)
         meas[name] = best
     assert meas["conflict"] > meas["clean"], meas
+
+
+def test_lowering_diagnostics_see_involuntary_remat(devices):
+    """The device-order pathology the cost model cannot price (created
+    INSIDE lowering by the composed shardings) is surfaced by the
+    lowering diagnostics: XLA's 'Involuntary full rematerialization'
+    warnings are captured at AOT compile. The known conflict plan
+    reports at least one; the clean DP plan reports none."""
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device mesh")
+
+    def loss(params, x, y):
+        h = x @ params["w1"]
+        o = h @ params["w2"]
+        return jnp.mean((o - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    D, B = 512, 64
+    params = {"w1": jax.random.normal(k, (D, D)) * 0.05,
+              "w2": jax.random.normal(k, (D, D)) * 0.05}
+    x = jax.random.normal(k, (B, D))
+    y = jnp.zeros((B, D))
+    topo = MeshTopology([("x", 2), ("y", 4)])
+    conflict = {0: {"y": DimStrategy.split_on(1, 4)},
+                1: {"x": DimStrategy.split_on(0, 2)}}
+    clean = {2: {"x": DimStrategy.split_on(0, 2)},
+             3: {"x": DimStrategy.split_on(0, 2)}}
+
+    tx = optax.sgd(0.01)
+    opt_state = tx.init(params)
+
+    def train_step(params, opt_state, x, y):
+        l, g = jax.value_and_grad(loss)(params, x, y)
+        u, opt_state = tx.update(g, opt_state, params)
+        return l, optax.apply_updates(params, u), opt_state
+
+    n_state = len(jax.tree_util.tree_leaves((params, opt_state)))
+    diags = {}
+    for name, ann in [("conflict", conflict), ("clean", clean)]:
+        plan = auto_parallel(train_step, topo, params, opt_state, x, y,
+                             annotations=ann,
+                             state_alias={1 + i: i
+                                          for i in range(n_state)})
+        diags[name] = plan.lowering_diagnostics()
+    assert diags["conflict"], diags
+    assert diags["clean"] == [], diags
