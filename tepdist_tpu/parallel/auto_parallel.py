@@ -517,7 +517,9 @@ def _materialize_explored(best, fn, graph, in_tree, out_tree, example_args,
             num_micro_batches=best["num_micro_batches"],
             intra_tp=best.get("intra_tp", 1),
             cost=best["cost"], candidates=candidates,
-            loss_fn=fn, params=params, example_batch=tuple(batch))
+            loss_fn=fn, params=params, example_batch=tuple(batch),
+            placement=best.get("placement", "blocked"),
+            interleave_groups=best.get("interleave_groups"))
 
     topo = best["topology"]
     is_seq = any(n == "seq" and s > 1 for n, s in topo.device_axes())

@@ -49,6 +49,8 @@ class PipelineWinner:
     example_batch: Tuple[Any, ...]
     kind: str = "pipeline"
     mode: str = "exploration"
+    placement: str = "blocked"
+    interleave_groups: Any = None
 
     def build(self, optimizer, devices=None, **kwargs):
         from tepdist_tpu.parallel.pipeline import plan_pipeline
@@ -59,7 +61,10 @@ class PipelineWinner:
                              *self.example_batch)
         return PipelineExecutable(prog, devices=devices,
                                   optimizer=optimizer,
-                                  intra_stage_tp=self.intra_tp, **kwargs)
+                                  intra_stage_tp=self.intra_tp,
+                                  placement=self.placement,
+                                  interleave_groups=self.interleave_groups,
+                                  **kwargs)
 
 
 # ----------------------------------------------------------------------
@@ -183,10 +188,14 @@ def pipeline_candidates(loss_fn: Callable, params, example_batch,
     from tepdist_tpu.runtime.execution_plan import build_pipeline_task_dag
 
     out: List[Dict[str, Any]] = []
-    for S in (2, 4, 8):
-        if S > n_devices or n_devices % S:
+    for S in (2, 4, 8, 16):
+        # Blocked placements need S <= devices; VIRTUAL stages (the
+        # interleaved variants below) only need S/v groups to fit, so
+        # S up to v * n_devices stays proposable.
+        blocked_ok = S <= n_devices and n_devices % S == 0
+        if not blocked_ok and (S % 2 or n_devices % (S // 2)):
             continue
-        per = n_devices // S
+        per = n_devices // S if blocked_ok else 0
         for M in (micro_options if micro_options is not None
                   else {num_micro_batches, 2 * num_micro_batches}):
             if batch_rows % M:
@@ -199,7 +208,7 @@ def pipeline_candidates(loss_fn: Callable, params, example_batch,
             stage_devs = [tuple(range(s * per, (s + 1) * per))
                           for s in range(S)]
             stage_graphs = None
-            for tp in (1, 2, 4, 8):
+            for tp in ((1, 2, 4, 8) if blocked_ok else ()):
                 if tp > per or per % tp:
                     continue
                 try:
@@ -224,10 +233,37 @@ def pipeline_candidates(loss_fn: Callable, params, example_batch,
                     out.append(
                         {"kind": "pipeline", "num_stages": S,
                          "num_micro_batches": M, "intra_tp": tp,
-                         "cost": cost})
+                         "placement": "blocked", "cost": cost})
                 except Exception as e:  # noqa: BLE001
                     log.info("pipeline proposal S=%d M=%d tp=%d failed: %s",
                              S, M, tp, e)
+            # Interleaved variants (Megatron virtual stages, reference:
+            # the stage ordinal placed round-robin): the SAME S-stage cut
+            # over G = S/v device groups, stage s -> group s % G. The
+            # scheduler's interleaved-aware candidate search prices the
+            # chunk-alternating schedule (task_scheduler._ranks).
+            for v in (2,):
+                if S % v or S // v < 2:
+                    continue
+                G = S // v
+                if n_devices % G:
+                    continue
+                per_g = n_devices // G
+                groups = [tuple(range(g * per_g, (g + 1) * per_g))
+                          for g in range(G)]
+                try:
+                    dag, _ = build_pipeline_task_dag(
+                        prog, [groups[s % G] for s in range(S)])
+                    cost = Evaluator(
+                        MeshTopology([("stage", S)])).run_pipeline(dag)
+                    out.append(
+                        {"kind": "pipeline", "num_stages": S,
+                         "num_micro_batches": M, "intra_tp": 1,
+                         "placement": "interleaved",
+                         "interleave_groups": G, "cost": cost})
+                except Exception as e:  # noqa: BLE001
+                    log.info("interleaved proposal S=%d/G=%d M=%d "
+                             "failed: %s", S, G, M, e)
     return out
 
 
@@ -324,7 +360,9 @@ def candidate_summary(candidates, best=None) -> List[Dict[str, Any]]:
         cfg = (str(c["topology"]) if c["kind"] == "spmd" else
                f"S={c['num_stages']} M={c['num_micro_batches']}"
                + (f" tp={c['intra_tp']}" if c.get("intra_tp", 1) > 1
-                  else ""))
+                  else "")
+               + (f" il/G={c['interleave_groups']}"
+                  if c.get("placement") == "interleaved" else ""))
         cost = c["cost"]
         rows.append({
             "kind": c["kind"], "config": cfg,

@@ -23,9 +23,15 @@ import logging
 import os
 import re
 import tempfile
+import threading
 from typing import List
 
 log = logging.getLogger(__name__)
+
+# The fd-level capture is process-global: concurrent captures would nest
+# dup2's and could leave fd 2 pointing at a deleted temp file. One
+# compile-under-capture at a time.
+_capture_lock = threading.Lock()
 
 _REMAT_RE = re.compile(
     r"Involuntary full rematerialization[^\n]*?for HLO operation\s+"
@@ -56,8 +62,9 @@ def involuntary_remats(jitted_fn, example_args) -> List[str]:
     Involuntary full rematerialization — [] for a cleanly shardable
     lowering. The compile is cached by jax, so a subsequent real call
     pays nothing extra."""
-    with _capture_stderr_fd() as buf:
-        jitted_fn.lower(*example_args).compile()
+    with _capture_lock:
+        with _capture_stderr_fd() as buf:
+            jitted_fn.lower(*example_args).compile()
     hits = _REMAT_RE.findall(buf["text"])
     # Re-emit non-remat stderr lines at WARNING so the capture never
     # swallows an unrelated compile warning.

@@ -462,6 +462,8 @@ class TepdistServicer:
         S = best["num_stages"]
         M = best["num_micro_batches"]
         tp = best.get("intra_tp", 1)
+        placement = best.get("placement", "blocked")
+        il_groups = best.get("interleave_groups")
         opt_sds = jax.eval_shape(optimizer.init, params_sds)
         n_params = len(params_sds)
         n_state = n_params + len(jax.tree_util.tree_leaves(opt_sds))
@@ -483,6 +485,8 @@ class TepdistServicer:
             "num_stages": S,
             "num_micro_batches": M,
             "intra_tp": tp,
+            "placement": placement,
+            "interleave_groups": il_groups,
             "planner_seconds": round(time.time() - t0, 3),
             "explored": explored,
         }
@@ -518,7 +522,9 @@ class TepdistServicer:
         else:
             exe = PipelineExecutable(prog, devices=self.devices,
                                      optimizer=optimizer,
-                                     intra_stage_tp=tp)
+                                     intra_stage_tp=tp,
+                                     placement=placement,
+                                     interleave_groups=il_groups)
         plan = _CompiledPipelinePlan(exe, optimizer, n_params, n_state,
                                      n_state + len(batch_sds), summary,
                                      is_fleet=is_fleet)
@@ -628,6 +634,24 @@ class TepdistServicer:
         }
         if explored is not None:
             summary["explored"] = explored
+            # Winner-only lowering post-check (the search loop cannot
+            # afford a compile per candidate): AOT-compile the chosen
+            # plan NOW — reference posture, BuildExecutionPlan compiles
+            # (service_rt.cc:218) — capturing GSPMD's involuntary-remat
+            # warnings, the device-order pathology no pre-lowering cost
+            # model prices. The compile is cached; the first ExecutePlan
+            # pays nothing extra.
+            from tepdist_tpu.parallel.lowering_check import (
+                involuntary_remats,
+            )
+
+            sds = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
+                   for v in graph.invars]
+            try:
+                explored["lowering_remats"] = involuntary_remats(step_fn,
+                                                                 sds)
+            except Exception as e:  # noqa: BLE001 — diagnostics only
+                log.warning("lowering post-check failed: %r", e)
         from jax.sharding import NamedSharding
         shardings = [NamedSharding(mesh, spec) for spec in splan.in_specs]
         plan = _CompiledPlan(step_fn, splan.in_specs, topology, var_idx,

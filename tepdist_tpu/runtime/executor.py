@@ -52,6 +52,7 @@ class PipelineExecutable:
         intra_stage_tp: int = 1,
         stage_var_mem_limit: Optional[int] = None,
         placement: str = "blocked",
+        interleave_groups: Optional[int] = None,
     ):
         """``intra_stage_dp``: shard the micro-batch dim over each stage's
         device subset (PP x DP hybrid — the reference's nested split
@@ -89,16 +90,24 @@ class PipelineExecutable:
         if placement not in ("blocked", "interleaved"):
             raise ValueError(f"unknown placement {placement!r}")
         if placement == "interleaved":
-            # Group count = min(devices, stages); each group hosts S/G
-            # virtual stages (round-robin). A non-dividing S would
-            # silently unbalance or collapse to G=1 — error like the
-            # blocked path's under-provisioning check does.
-            G = min(len(devices), S)
+            # Group count = ``interleave_groups`` when given (the
+            # exploration winner's G — e.g. 8 virtual stages over 4
+            # groups of 2 devices), else min(devices, stages); each group
+            # hosts S/G virtual stages (round-robin). A non-dividing S
+            # would silently unbalance or collapse to G=1 — error like
+            # the blocked path's under-provisioning check does.
+            G = interleave_groups or min(len(devices), S)
+            if len(devices) % G:
+                raise ValueError(
+                    f"interleaved placement: {len(devices)} devices not "
+                    f"divisible into {G} groups")
             if S % G:
+                src = ("interleave_groups" if interleave_groups
+                       else "min(devices, stages)")
                 raise ValueError(
                     f"interleaved placement needs num_stages ({S}) "
-                    f"divisible by the group count ({G} = min(devices, "
-                    f"stages)); pick a dividing stage count")
+                    f"divisible by the group count ({G} from {src}); "
+                    "pick a dividing stage count")
             per_g = len(devices) // G
             groups = [tuple(devices[g * per_g:(g + 1) * per_g])
                       for g in range(G)]

@@ -186,6 +186,8 @@ def plan_training(
     annotations: Optional[dict] = None,
     var_mem_limit: Optional[int] = None,
     explore: bool = False,
+    placement: str = "blocked",
+    interleave_groups: Optional[int] = None,
 ) -> TrainingPlan:
     """Plan + compile a full training loop for ``loss_fn(params, *batch)``
     with an optax ``optimizer``. ``explore=True`` (or OPT_LEVEL=2 with no
@@ -214,6 +216,9 @@ def plan_training(
             num_micro_batches = best["num_micro_batches"]
             if intra_stage_tp is None:
                 intra_stage_tp = best.get("intra_tp", 1)
+            placement = best.get("placement", placement)
+            interleave_groups = best.get("interleave_groups",
+                                         interleave_groups)
         else:
             topology = best["topology"]
     if num_stages is None:
@@ -282,7 +287,9 @@ def plan_training(
             tp = env.intra_stage_tp
         exe = PipelineExecutable(prog, devices=devices, optimizer=optimizer,
                                  intra_stage_tp=tp or 1,
-                                 stage_var_mem_limit=var_mem_limit)
+                                 stage_var_mem_limit=var_mem_limit,
+                                 placement=placement,
+                                 interleave_groups=interleave_groups)
         return _PipelineTrainingPlan(exe, params)
 
     # ---- SPMD (+ GA) path ---------------------------------------------
