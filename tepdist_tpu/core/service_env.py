@@ -78,6 +78,14 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
     ("HBM_GB", float, -1.0, "[tpu] override per-device HBM GB for the cost "
      "model (reference: the MEMORY per-device byte default, "
      "evaluator.h:53)"),
+    ("ASYNC_TRANSPORT", str, "auto", "[tpu] scheduler transport occupancy: "
+     "'auto' = async DMA (launch-alpha device hold) on accelerator "
+     "backends, device-blocking on the CPU mesh (where device_put IS the "
+     "device); '1'/'0' force"),
+    ("TASK_OVERHEAD_US", float, 0.0, "[tpu] per-task HOST dispatch "
+     "overhead (us) added to every task in the schedule model; 0 = pure "
+     "device model (overheads overlap long device compute). The CPU-mesh "
+     "measured validation calibrates it to the Python dispatch floor"),
     ("REMAT_POLICY", str, "none", "[tpu] jax.checkpoint policy for stages"),
     ("DONATE_ARGS", bool, True, "[tpu] donate variable buffers into the step"),
 ]

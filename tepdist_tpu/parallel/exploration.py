@@ -205,8 +205,8 @@ def pipeline_candidates(loss_fn: Callable, params, example_batch,
             except Exception as e:  # noqa: BLE001
                 log.info("pipeline proposal S=%d M=%d failed: %s", S, M, e)
                 continue
-            stage_devs = [tuple(range(s * per, (s + 1) * per))
-                          for s in range(S)]
+            stage_devs = ([tuple(range(s * per, (s + 1) * per))
+                           for s in range(S)] if blocked_ok else None)
             stage_graphs = None
             for tp in ((1, 2, 4, 8) if blocked_ok else ()):
                 if tp > per or per % tp:

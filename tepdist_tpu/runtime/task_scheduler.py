@@ -119,13 +119,44 @@ class TaskScheduler:
         alpha while the wire latency gates the CONSUMER (task_time), so
         extra pipeline hops (interleaved placements) do not serialize
         against compute (reference: ASYNC_SEND/ASYNC_RECV,
-        service_env.h:46-47 — PJRT dispatch is async)."""
-        t = self.task_time(n)
-        if n.task_type in (TaskType.SEND, TaskType.RECV):
-            return min(t, ALPHA_S)
-        return t
+        service_env.h:46-47 — PJRT dispatch is async). On the CPU mesh
+        a transport IS the device (device_put copies on it), so
+        ASYNC_TRANSPORT=auto keeps the schedule model faithful to the
+        fabric it will run on (the measured-validation contract,
+        tests/test_evaluator_measured.py); '1'/'0' force."""
+        if (n.task_type in (TaskType.SEND, TaskType.RECV)
+                and self._async_transport()):
+            # The HOST dispatch floor is paid regardless — only the WIRE
+            # time collapses to the launch alpha.
+            oh = ServiceEnv.get().task_overhead_us * 1e-6
+            return oh + min(self._device_time(n), ALPHA_S)
+        return self.task_time(n)
+
+    def _async_transport(self) -> bool:
+        mode = ServiceEnv.get().async_transport.lower()
+        if mode in ("1", "true", "on", "yes"):
+            return True
+        if mode in ("0", "false", "off", "no"):
+            return False
+        if mode != "auto":
+            import warnings
+            warnings.warn(f"unknown ASYNC_TRANSPORT={mode!r}; using auto")
+        if not hasattr(self, "_async_auto"):
+            import jax
+            self._async_auto = jax.default_backend() != "cpu"
+        return self._async_auto
 
     def task_time(self, n: TaskNode) -> float:
+        # Per-task host dispatch floor (TASK_OVERHEAD_US): every task is
+        # a host-side dispatch (jit call / device_put / store op). 0 by
+        # default — on TPU the host work overlaps long device compute —
+        # but on the CPU mesh it's the measured per-task floor, and
+        # pricing it is what keeps pipeline candidates honest against
+        # single-jit SPMD rivals in the measured-validation contract.
+        oh = ServiceEnv.get().task_overhead_us * 1e-6
+        return oh + self._device_time(n)
+
+    def _device_time(self, n: TaskNode) -> float:
         if n.task_type == TaskType.COMPUTE:
             ndev = max(len(n.device_group), 1)
             return max(PerfUtils.compute_time(n.flops / ndev, self.spec), 1e-7)

@@ -145,6 +145,7 @@ def test_explore_candidate_ranking_vs_measured(devices, n_devices, tol,
     evaluator's argmin must measure within tol of the true best."""
     if len(devices) < n_devices:
         pytest.skip(f"needs {n_devices} devices")
+    from tepdist_tpu.core.service_env import ServiceEnv
     from tepdist_tpu.train import explore_parallelism, plan_training
 
     params = gpt2.init_params(CFG, jax.random.PRNGKey(0))
@@ -152,8 +153,18 @@ def test_explore_candidate_ranking_vs_measured(devices, n_devices, tol,
     tx = optax.sgd(1e-3)
     loss = lambda p, t: gpt2.loss_fn(p, t, CFG)
 
-    best = explore_parallelism(loss, params, tokens, n_devices=n_devices,
-                               num_micro_batches=4)
+    # Calibrate the schedule model to the fabric being MEASURED: on the
+    # CPU mesh every task pays a ~0.4 ms Python dispatch floor (pinned
+    # protocol: ~24 ms/step over ~40 tasks at S=2 M=4, of which the
+    # device model prices only a fraction). TASK_OVERHEAD_US=0 (the TPU
+    # default) models overheads as overlapped by long device compute.
+    ServiceEnv.reset({"TASK_OVERHEAD_US": 400.0})
+    try:
+        best = explore_parallelism(loss, params, tokens,
+                                   n_devices=n_devices,
+                                   num_micro_batches=4)
+    finally:
+        ServiceEnv.reset()
     cands = best["candidates"]
 
     def find_spmd(axes):

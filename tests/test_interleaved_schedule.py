@@ -61,7 +61,7 @@ def test_interleaved_realizes_megatron_bubble_gain():
         # Hops cheap relative to stage compute (the ICI/DCN regime the
         # technique targets; the CPU-mesh default DCN constant would make
         # this transport-bound and measure the wire, not the schedule).
-        ServiceEnv.reset({"PP_BANDWIDTH": 50000.0})
+        ServiceEnv.reset({"PP_BANDWIDTH": 50000.0, "ASYNC_TRANSPORT": "1"})
         prog16 = plan_pipeline(loss, 16, M, params, x, y)
         dag_i, _ = build_pipeline_task_dag(
             prog16, [(s % 8,) for s in range(16)])
@@ -106,15 +106,20 @@ def test_async_transport_occupancy():
     loss, params, x, y = _deep_mlp(depth=4, batch=2048)
     prog = plan_pipeline(loss, 2, 2, params, x, y)
     dag, _ = build_pipeline_task_dag(prog, [(0,), (1,)])
-    ts = TaskScheduler(dag)
-    send = next(n for n in dag.nodes if n.task_type == TaskType.SEND)
-    assert ts.occupancy_time(send) <= ts.task_time(send)
-    r = ts._simulate(2)
-    # The consumer RECV's children never start before the send's full
-    # wire time has elapsed.
-    recv = next(c for c in send.children
-                if dag.node(c).task_type == TaskType.RECV)
-    assert r.start[recv] >= r.start[send.id] + ts.task_time(send) - 1e-12
+    ServiceEnv.reset({"ASYNC_TRANSPORT": "1"})
+    try:
+        ts = TaskScheduler(dag)
+        send = next(n for n in dag.nodes if n.task_type == TaskType.SEND)
+        assert ts.occupancy_time(send) <= ts.task_time(send)
+        r = ts._simulate(2)
+        # The consumer RECV's children never start before the send's
+        # full wire time has elapsed.
+        recv = next(c for c in send.children
+                    if dag.node(c).task_type == TaskType.RECV)
+        assert (r.start[recv]
+                >= r.start[send.id] + ts.task_time(send) - 1e-12)
+    finally:
+        ServiceEnv.reset()
 
 
 def test_exploration_proposes_interleaved_placements():
@@ -126,7 +131,7 @@ def test_exploration_proposes_interleaved_placements():
 
     loss, params, x, y = _deep_mlp(depth=16, width=512, batch=16384)
     try:
-        ServiceEnv.reset({"PP_BANDWIDTH": 50000.0})
+        ServiceEnv.reset({"PP_BANDWIDTH": 50000.0, "ASYNC_TRANSPORT": "1"})
         cands = pipeline_candidates(loss, params, (x, y), 8, 16384,
                                     num_micro_batches=8,
                                     micro_options=[8])
