@@ -573,6 +573,47 @@ def bench_runtime_protocol() -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_trace_overhead() -> dict:
+    """Telemetry sanity line: the span() fast path must be a no-op when
+    tracing is disabled (telemetry/trace.py contract — instrumented hot
+    paths pay one branch, zero allocation). Measures both modes against a
+    swapped-in private tracer so the numbers neither pollute nor drain
+    the process ring buffer; the singleton identity is asserted outright,
+    so a regression fails the line instead of shading the number."""
+    from tepdist_tpu.telemetry import _NULL_SPAN
+    from tepdist_tpu.telemetry import trace as _trace
+
+    n = 20000
+    prev = _trace.tracer()
+    tmp = _trace.Tracer(capacity=n, enabled=False)
+    _trace._TRACER = tmp
+    try:
+        assert _trace.span("bench", cat="bench") is _NULL_SPAN, \
+            "disabled span() must return the shared no-op singleton"
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            with _trace.span("bench", cat="bench"):
+                pass
+        disabled_ns = (time.perf_counter_ns() - t0) / n
+
+        tmp.enabled = True
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            with _trace.span("bench", cat="bench"):
+                pass
+        enabled_ns = (time.perf_counter_ns() - t0) / n
+    finally:
+        _trace._TRACER = prev
+    return {
+        "metric": "trace_overhead",
+        "value": round(disabled_ns, 1),
+        "unit": "ns/span disabled",
+        "enabled_ns_per_span": round(enabled_ns, 1),
+        "spans_recorded_enabled": len(tmp),
+        "noop_fast_path": True,
+    }
+
+
 def _persist_tpu_headline(line: dict) -> None:
     """Record the last-good TPU headline with provenance so a future
     tunnel wedge degrades to a STALE-FLAGGED TPU number, never a CPU
@@ -679,6 +720,11 @@ def main() -> None:
         except Exception:
             extra.append({"metric": "runtime", "error":
                           traceback.format_exc(limit=3).splitlines()[-1]})
+        try:
+            extra.append(bench_trace_overhead())
+        except Exception:
+            extra.append({"metric": "trace_overhead", "error":
+                          traceback.format_exc(limit=3).splitlines()[-1]})
         # Carry forward the last TPU round's secondary lines STALE-FLAGGED
         # (mirroring the headline policy) instead of silently dropping
         # them: the fresh runtime line replaces only its own metric.
@@ -741,6 +787,7 @@ def main() -> None:
         except Exception:                 # cannot truncate prior lines
             pass
     selected = {
+        "trace": bench_trace_overhead,   # ~ms; telemetry no-op guarantee
         "117m": lambda: bench_gpt2_117m(True),
         "runtime": bench_runtime_protocol,   # pinned protocol, every round
         "flash": bench_flash_attention_long,

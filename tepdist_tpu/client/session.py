@@ -320,6 +320,18 @@ class TepdistSession:
     def restore(self, global_step: int = -1) -> None:
         self.client.do_remote_restore(global_step=global_step)
 
+    def dump_trace(self, path: Optional[str] = None,
+                   clear: bool = False) -> Optional[str]:
+        """Pull the server's span buffer + metrics (GetTelemetry),
+        clock-align them against this client's own spans, and write ONE
+        merged Perfetto-loadable trace. ``path=None`` lands in
+        ``$TEPDIST_DUMP_DIR`` (core/debug_dump.py policy). Returns the
+        written path, or None if the dump could not be written. Requires
+        ``TEPDIST_TRACE=1`` (or DEBUG) on both processes for a non-empty
+        timeline."""
+        from tepdist_tpu.telemetry import dump_merged_trace
+        return dump_merged_trace([self.client], path=path, name="trace")
+
     def close(self) -> None:
         # Drain queued async steps before the channel goes away.
         pool = getattr(self, "_pool", None)

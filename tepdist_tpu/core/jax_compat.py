@@ -1,0 +1,68 @@
+"""Version-portability shims for jax internals the planner constructs
+directly.
+
+``jax.core.Var``'s constructor changed across releases: 0.4.x takes
+``Var(suffix, aval)`` while newer jax takes ``Var(aval)``. Every place
+that mints a fresh variable (call inlining, liveness renaming, jaxpr
+deserialization) goes through :func:`fresh_var` so the repo runs on
+either signature.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax.extend.core import Var
+except ImportError:  # older jax layouts
+    from jax.core import Var
+
+_VAR_TAKES_SUFFIX = "suffix" in inspect.signature(Var.__init__).parameters
+
+
+def fresh_var(aval) -> Var:
+    """A new unique ``Var`` of the given aval, on any supported jax."""
+    return Var("", aval) if _VAR_TAKES_SUFFIX else Var(aval)
+
+
+# jax >= 0.5 exposes shard_map at top level with `axis_names` naming the
+# MANUAL axes; 0.4.x has it under jax.experimental with the complementary
+# `auto` set instead. shard_map() here takes the newer keyword surface and
+# translates on older jax.
+import jax as _jax
+
+_shard_map_impl = getattr(_jax, "shard_map", None)
+if _shard_map_impl is None:
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    def shard_map(f, mesh, in_specs, out_specs, axis_names=None, **kw):
+        if axis_names is not None:
+            kw["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+        if "check_vma" in kw:  # renamed from check_rep in newer jax
+            kw["check_rep"] = kw.pop("check_vma")
+        # The old replication checker is a static verifier with false
+        # positives (e.g. cond branches); it affects no numerics, so
+        # default it off unless the caller asked for it.
+        kw.setdefault("check_rep", False)
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, **kw)
+else:
+    shard_map = _shard_map_impl
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` (newer jax) or the classic ``psum(1, axis)``."""
+    impl = getattr(_jax.lax, "axis_size", None)
+    if impl is not None:
+        return impl(axis_name)
+    return _jax.lax.psum(1, axis_name)
+
+
+def pcast(x, axes, to="varying"):
+    """``lax.pcast`` passthrough. jax without the varying-manual-axes
+    (vma) machinery has no pcast — and needs none: under its shard_map
+    every value is already treated as varying, so identity is exact."""
+    impl = getattr(_jax.lax, "pcast", None)
+    if impl is None:
+        return x
+    return impl(x, axes, to=to)

@@ -88,6 +88,14 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
      "measured validation calibrates it to the Python dispatch floor"),
     ("REMAT_POLICY", str, "none", "[tpu] jax.checkpoint policy for stages"),
     ("DONATE_ARGS", bool, True, "[tpu] donate variable buffers into the step"),
+    # --- telemetry --------------------------------------------------------
+    ("TEPDIST_TRACE", bool, False, "record step/planner spans for the "
+     "merged Perfetto timeline (telemetry/); DEBUG implies it"),
+    ("TEPDIST_TRACE_CAPACITY", int, 65536, "span ring-buffer capacity per "
+     "process (oldest spans are dropped)"),
+    ("LOWERING_POSTCHECK", bool, True, "winner-only involuntary-remat "
+     "lowering check after exploration (parallel/lowering_check.py); "
+     "records the involuntary_remat counter + a warning"),
 ]
 
 _CONFIG_FILE_ENV = "TEPDIST_CONFIG"

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tepdist_tpu.core.jax_compat import pcast, shard_map
+
 
 def _pipeline_local(stage_params, x_micro, *, stage_fn, axis: str,
                     num_stages: int, num_micro: int, vary_axes=None):
@@ -65,8 +67,8 @@ def _pipeline_local(stage_params, x_micro, *, stage_fn, axis: str,
     state0 = jnp.zeros(mb_shape, x_micro.dtype)
     out0 = jnp.zeros((M,) + mb_shape, x_micro.dtype)
     vary = tuple(vary_axes) if vary_axes else (axis,)
-    state0 = lax.pcast(state0, vary, to="varying")
-    out0 = lax.pcast(out0, vary, to="varying")
+    state0 = pcast(state0, vary, to="varying")
+    out0 = pcast(out0, vary, to="varying")
     (_, out_buf), _ = lax.scan(tick, (state0, out0), jnp.arange(T))
     # Only the last stage holds real outputs; psum makes them replicated.
     mask = (idx == S - 1).astype(x_micro.dtype)
@@ -118,7 +120,7 @@ def collective_pipeline(
             # Partial-manual shard_map: the model axis stays auto (GSPMD).
             kw["axis_names"] = {axis} | (
                 {data_axis} if data_axis else set())
-        inner = jax.shard_map(
+        inner = shard_map(
             lambda p, x: local(
                 jax.tree_util.tree_map(lambda a: a[0], p), x),
             mesh=mesh,

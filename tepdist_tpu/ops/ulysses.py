@@ -17,6 +17,8 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tepdist_tpu.core.jax_compat import shard_map
+
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
                    scale: Optional[float],
@@ -69,7 +71,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
     fn = functools.partial(_ulysses_local, axis_name=axis_name,
                            causal=causal, scale=scale, inner=inner,
                            return_lse=return_lse)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=(spec, P(None, None, axis_name)) if return_lse else spec,

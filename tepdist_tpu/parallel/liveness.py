@@ -17,6 +17,7 @@ from typing import Dict, List
 
 from jax.extend import core as jexcore
 
+from tepdist_tpu.core.jax_compat import fresh_var
 from tepdist_tpu.graph.jaxpr_graph import JaxprGraph
 
 Var = jexcore.Var
@@ -62,7 +63,7 @@ def optimize_liveness(graph: JaxprGraph, min_range: int = 32,
             if type(o).__name__ == "DropVar":
                 new_outs.append(o)
             else:
-                fresh = Var(o.aval)
+                fresh = fresh_var(o.aval)
                 out_map[o] = fresh
                 new_outs.append(fresh)
         return eqn.replace(outvars=new_outs)

@@ -34,7 +34,7 @@ log = logging.getLogger(__name__)
 _capture_lock = threading.Lock()
 
 _REMAT_RE = re.compile(
-    r"Involuntary full rematerialization[^\n]*?for HLO operation\s+"
+    r"Involuntary full rematerialization[^\n]*?for HLO operation:?\s+"
     r"%?([\w.\-]+)[^\n]*")
 
 

@@ -9,11 +9,13 @@ INT_MAX message sizes; methods mirror the TePDist RPC set.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from tepdist_tpu.rpc import protocol
+from tepdist_tpu.telemetry import metrics, span
 
 
 class GRPCStub:
@@ -40,7 +42,18 @@ class GRPCStub:
 
     def call(self, method: str, payload: bytes, timeout: float = 300.0
              ) -> bytes:
-        return self._methods[method](payload, timeout=timeout)
+        t0 = time.perf_counter()
+        with span(f"rpc:{method}", cat="rpc", addr=self.address,
+                  req_bytes=len(payload)) as sp:
+            resp = self._methods[method](payload, timeout=timeout)
+            sp.set(resp_bytes=len(resp))
+        m = metrics()
+        # Metrics are always on (spans are not): measure independently.
+        m.histogram(f"rpc_ms:{method}").observe(
+            (time.perf_counter() - t0) * 1e3)
+        m.counter(f"rpc_bytes_out:{method}").inc(len(payload))
+        m.counter(f"rpc_bytes_in:{method}").inc(len(resp))
+        return resp
 
     def wait_ready(self, timeout: float = 30.0) -> None:
         import grpc
@@ -63,6 +76,21 @@ class TepdistClient:
 
     def wait_ready(self, timeout: float = 30.0) -> None:
         self.stub.wait_ready(timeout)
+
+    def get_telemetry(self, clear: bool = False) -> Dict[str, Any]:
+        """Pull the worker's span buffer + metrics snapshot, annotated
+        with the clock alignment estimate: ``offset_us`` is the NTP-style
+        midpoint offset (worker clock minus client clock, accurate to
+        half the round-trip ``rtt_us``) — subtract it from the worker's
+        span timestamps to merge timelines (telemetry/export.py)."""
+        t0 = time.time_ns() // 1000
+        resp = self.stub.call("GetTelemetry",
+                              protocol.pack({"clear": clear}))
+        t1 = time.time_ns() // 1000
+        header, _ = protocol.unpack(resp)
+        header["rtt_us"] = t1 - t0
+        header["offset_us"] = header.get("now_us", t1) - (t0 + t1) / 2
+        return header
 
     # -- plan building --------------------------------------------------
     def build_execution_plan(

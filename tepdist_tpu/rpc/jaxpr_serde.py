@@ -28,6 +28,8 @@ import jax
 from jax.extend import core as jexcore
 from jax._src import core as _core
 
+from tepdist_tpu.core.jax_compat import fresh_var
+
 import logging
 log = logging.getLogger(__name__)
 
@@ -88,6 +90,8 @@ def _build_primitive_registry() -> Dict[str, Any]:
         modules.extend([m1, m2, m3, m4, m5, m6, m7, m8, m9, m10])
         import jax._src.lax.parallel as m11
         modules.append(m11)
+        import jax._src.ad_checkpoint as m11b  # name_p / remat_p
+        modules.append(m11b)
     except ImportError:  # pragma: no cover - internal layout moved
         pass
     try:
@@ -368,8 +372,11 @@ def encode_value(v: Any) -> Any:
                 return {"t": "pl_" + cls_name.lower(),
                         "v": {f.name: encode_value(getattr(v, f.name))
                               for f in _dc.fields(cls)}}
-        from jax._src.frozen_dict import FrozenDict as _FrozenDict
-        if isinstance(v, _FrozenDict):
+        try:  # not present on jax 0.4.x (params use plain dicts there)
+            from jax._src.frozen_dict import FrozenDict as _FrozenDict
+        except ImportError:
+            _FrozenDict = None
+        if _FrozenDict is not None and isinstance(v, _FrozenDict):
             return {"t": "pl_frozendict",
                     "v": [[encode_value(k), encode_value(x)]
                           for k, x in dict(v).items()]}
@@ -456,9 +463,12 @@ def decode_value(v: Any) -> Any:
                else _pl_core.GridMapping)
         return cls(**{k: decode_value(x) for k, x in v["v"].items()})
     if t == "pl_frozendict":
-        from jax._src.frozen_dict import FrozenDict as _FrozenDict
-        return _FrozenDict({decode_value(k): decode_value(x)
-                            for k, x in v["v"]})
+        items = {decode_value(k): decode_value(x) for k, x in v["v"]}
+        try:
+            from jax._src.frozen_dict import FrozenDict as _FrozenDict
+        except ImportError:  # jax 0.4.x: plain dict is what params held
+            return items
+        return _FrozenDict(items)
     raise TypeError(f"unknown tag {t}")
 
 
@@ -570,7 +580,7 @@ def _decode_jaxpr_struct(d: dict):
     def dec_var(a):
         i = a["id"]
         if i not in env:
-            env[i] = jexcore.Var(_make_aval(a["aval"]))
+            env[i] = fresh_var(_make_aval(a["aval"]))
         return env[i]
 
     def dec_atom(a):

@@ -25,6 +25,10 @@ RPC surface (method -> reference RPC):
                            worker death is detected at heartbeat latency,
                            not RPC-timeout latency)
   Ping                  -> GetDeviceHandles (liveness/metadata)
+  GetTelemetry          -> (no reference analogue: pulls the worker's span
+                           ring buffer + metrics snapshot, stamped with the
+                           worker's clock so the client can align fleets'
+                           timelines — telemetry/export.py)
 """
 
 from __future__ import annotations
@@ -52,6 +56,7 @@ METHODS = [
     "DoRemoteRestore",
     "AbortStep",
     "Ping",
+    "GetTelemetry",
 ]
 
 # Reference keeps INT_MAX message sizes (client_library.cc:152-156).

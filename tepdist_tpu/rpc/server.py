@@ -34,6 +34,7 @@ from tepdist_tpu.core.mesh import MeshTopology
 from tepdist_tpu.core.service_env import ServiceEnv
 from tepdist_tpu.rpc import protocol
 from tepdist_tpu.rpc.jaxpr_serde import deserialize_closed_jaxpr
+from tepdist_tpu.telemetry import metrics, span
 
 log = logging.getLogger("tepdist.server")
 
@@ -203,14 +204,22 @@ class TepdistServicer:
     def park_transfer(self, step: int, vals) -> None:
         with self._lock:
             self._parked_transfers.setdefault(step, []).append(vals)
+        metrics().counter("transfers_parked").inc()
 
     def release_parked_transfers(self, before_step: Optional[int] = None
                                  ) -> None:
         with self._lock:
             gone = [s for s in self._parked_transfers
                     if before_step is None or s < before_step]
+            freed = 0
             for s in gone:
+                freed += len(self._parked_transfers[s])
                 del self._parked_transfers[s]
+        if freed:
+            # NOTES_NEXT gap #5: parked != freed at shutdown is the
+            # bounded abort-path leak — now a visible counter delta
+            # instead of folklore.
+            metrics().counter("transfers_freed").inc(freed)
 
     def _sync_active_pipeline(self) -> None:
         """Flush the live pipeline runtime's state into the variable store
@@ -572,8 +581,9 @@ class TepdistServicer:
         env = ServiceEnv.get()
         if (opts.get("explore") and not axes and mode != "rule"
                 and env.opt_level >= 1 and "loss_module_blob" in opts):
-            (best, loss_fn, params_sds, batch_sds, optimizer,
-             explored) = self._explore_plan(opts, blobs)
+            with span("planner:explore", cat="planner"):
+                (best, loss_fn, params_sds, batch_sds, optimizer,
+                 explored) = self._explore_plan(opts, blobs)
             if best["kind"] == "pipeline":
                 return self._build_pipeline_plan(
                     opts, best, loss_fn, params_sds, batch_sds, optimizer,
@@ -593,7 +603,8 @@ class TepdistServicer:
                     optimizer, M_c,
                     topology_w, params_sds, batch_sds, n_state_client)
 
-        graph = JaxprGraph(closed, inline=False)
+        with span("planner:sketch", cat="planner"):
+            graph = JaxprGraph(closed, inline=False)
 
         if not axes:
             axes = [["data", len(self.devices)]]
@@ -607,11 +618,13 @@ class TepdistServicer:
                 int(i): {ax: DimStrategy(**d) for ax, d in spec.items()}
                 for i, spec in opts["annotations"].items()
             }
-        strategies = plan_axes(graph, topology, annotations, mode)
+        with span("planner:strategy_ilp", cat="planner", mode=mode):
+            strategies = plan_axes(graph, topology, annotations, mode)
         state_alias = {int(k): int(v)
                        for k, v in (opts.get("state_alias") or {}).items()}
         xform = SpmdTransform(graph, topology)
-        splan = xform.lower(strategies, state_alias=state_alias)
+        with span("planner:spmd_transform", cat="planner"):
+            splan = xform.lower(strategies, state_alias=state_alias)
         mesh = topology.to_jax_mesh(self.devices)
         # Donate aliased state buffers: the step's outputs replace them in
         # the variable store, so the old buffers are dead — donation avoids
@@ -620,7 +633,8 @@ class TepdistServicer:
                                if ii >= 0}))
         if ServiceEnv.get().disable_buffer_alias:
             donate = ()
-        step_fn = xform.executable(splan, mesh, donate_invars=donate)
+        with span("planner:compile", cat="planner"):
+            step_fn = xform.executable(splan, mesh, donate_invars=donate)
 
         var_idx = set(int(i) for i in opts.get("variable_indices", []))
         out_is_state = {oi: ii for oi, ii in state_alias.items()}
@@ -632,7 +646,7 @@ class TepdistServicer:
             "planner_seconds": round(time.time() - t0, 3),
             "n_constraints": len(splan.constraints),
         }
-        if explored is not None:
+        if explored is not None and env.lowering_postcheck:
             summary["explored"] = explored
             # Winner-only lowering post-check (the search loop cannot
             # afford a compile per candidate): AOT-compile the chosen
@@ -648,10 +662,24 @@ class TepdistServicer:
             sds = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
                    for v in graph.invars]
             try:
-                explored["lowering_remats"] = involuntary_remats(step_fn,
-                                                                 sds)
+                with span("planner:lowering_postcheck", cat="planner"):
+                    explored["lowering_remats"] = involuntary_remats(
+                        step_fn, sds)
             except Exception as e:  # noqa: BLE001 — diagnostics only
                 log.warning("lowering post-check failed: %r", e)
+            else:
+                n_remats = len(explored["lowering_remats"])
+                if n_remats:
+                    metrics().counter("involuntary_remat").inc(n_remats)
+                    log.warning(
+                        "explore winner %r (axes=%s): XLA reported %d "
+                        "involuntary full rematerialization(s) — the "
+                        "chosen sharding forces recompute the cost model "
+                        "did not price; consider a different topology",
+                        explored.get("winner"), summary.get("axes"),
+                        n_remats)
+        elif explored is not None:
+            summary["explored"] = explored
         from jax.sharding import NamedSharding
         shardings = [NamedSharding(mesh, spec) for spec in splan.in_specs]
         plan = _CompiledPlan(step_fn, splan.in_specs, topology, var_idx,
@@ -755,7 +783,7 @@ class TepdistServicer:
             arr.shape, sharding, lambda idx: arr[idx])
 
     # ------------------------------------------------------------------
-    def _execute_pipeline_plan(self, plan, header, blobs, t0) -> bytes:
+    def _execute_pipeline_plan(self, plan, header, blobs, sp) -> bytes:
         """ExecutePlan for a pipeline-kind plan (service explore winner):
         batch leaves route to the task-graph runtime; state lives in the
         per-stage executable and syncs through the variable store on
@@ -814,22 +842,26 @@ class TepdistServicer:
                         fetched[str(ii)] = {"meta": m,
                                             "blob": len(out_blobs)}
                         out_blobs.append(b)
+        sp.set(step=self.global_step)
         if ServiceEnv.get().debug:
             log.info("[ExecutePlan Duration] step=%d %.1f ms (pipeline)",
-                     self.global_step, (time.time() - t0) * 1e3)
+                     self.global_step, sp.elapsed_ms)
         return protocol.pack(
             {"outputs": metas, "output_indices": out_idx,
              "fetched": fetched, "global_step": self.global_step},
             out_blobs)
 
     def ExecutePlan(self, request: bytes, context=None) -> bytes:
-        t_exec0 = time.time()
         header, blobs = protocol.unpack(request)
         handle = int(header["handle"])
         plan = self.plan_cache.resolve(handle)
+        with span("ExecutePlan", cat="rpc", handle=handle,
+                  kind=plan.kind) as sp:
+            return self._execute_plan_body(plan, header, blobs, sp)
+
+    def _execute_plan_body(self, plan, header, blobs, sp) -> bytes:
         if plan.kind == "pipeline":
-            return self._execute_pipeline_plan(plan, header, blobs,
-                                               t_exec0)
+            return self._execute_pipeline_plan(plan, header, blobs, sp)
         # An SPMD plan (e.g. compile_generate) reading variables while a
         # pipeline runtime is live must see ITS state, not the store's
         # stale copy.
@@ -911,9 +943,10 @@ class TepdistServicer:
                         fetched[str(ii)] = {"meta": meta,
                                             "blob": len(out_blobs)}
                         out_blobs.append(blob)
+        sp.set(step=self.global_step)
         if ServiceEnv.get().debug:
             log.info("[ExecutePlan Duration] step=%d %.1f ms",
-                     self.global_step, (time.time() - t_exec0) * 1e3)
+                     self.global_step, sp.elapsed_ms)
         return protocol.pack(
             {"outputs": metas, "output_indices": out_idx,
              "fetched": fetched, "global_step": self.global_step},
@@ -995,7 +1028,9 @@ class TepdistServicer:
         header, _ = protocol.unpack(request)
         if self.worker_plan is None:
             return protocol.pack({"ok": True, "losses": []})
-        result = self.worker_plan.run_step(int(header.get("step", 0)))
+        step = int(header.get("step", 0))
+        with span("ExecuteRemotePlan", cat="rpc", step=step):
+            result = self.worker_plan.run_step(step)
         return protocol.pack({"ok": True, **result})
 
     def InitMeshTopology(self, request: bytes, context=None) -> bytes:
@@ -1124,6 +1159,24 @@ class TepdistServicer:
             "n_devices": len(self.devices),
             "platform": self.devices[0].platform,
             "global_step": self.global_step,
+        })
+
+    def GetTelemetry(self, request: bytes, context=None) -> bytes:
+        """Pull this process's span ring + metrics snapshot. ``now_us``
+        stamps the worker's epoch clock so the caller can estimate the
+        clock offset from the RPC round-trip (telemetry/export.py)."""
+        from tepdist_tpu import telemetry
+
+        header, _ = protocol.unpack(request)
+        spans = telemetry.tracer().snapshot(
+            clear=bool(header.get("clear")))
+        return protocol.pack({
+            "ok": True,
+            "task_index": self.task_index,
+            "now_us": time.time_ns() // 1000,
+            "enabled": telemetry.enabled(),
+            "spans": spans,
+            "metrics": telemetry.metrics().snapshot(),
         })
 
 

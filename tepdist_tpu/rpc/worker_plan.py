@@ -27,7 +27,16 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from tepdist_tpu.telemetry import _NULL_SPAN, metrics, span
+
 log = logging.getLogger(__name__)
+
+
+def _nbytes(val) -> int:
+    """Payload size of a task value (tuples = GA accumulator bundles)."""
+    if isinstance(val, tuple):
+        return sum(_nbytes(v) for v in val)
+    return int(getattr(val, "nbytes", 0) or 0)
 
 
 @dataclasses.dataclass
@@ -315,40 +324,45 @@ class WorkerPlan:
 
         from tepdist_tpu.core.service_env import ServiceEnv
         debug = ServiceEnv.get().debug
-        t_step0 = time.perf_counter() if debug else 0.0
-        for task in self.tasks:
-            tt = task["type"]
-            tid = task["node_id"]
-            s = task["stage"]
-            t_task0 = time.perf_counter() if debug else 0.0
-            try:
-                self._run_one(task, tt, tid, s, step, outputs, losses,
-                              stage_args)
-            except TimeoutError:
-                self._abandon_step(step)
-                raise
-            except Exception as e:  # noqa: BLE001 — add task context
-                self._abandon_step(step)
-                raise RuntimeError(
-                    f"worker {self.task_index} failed at task "
-                    f"{task['name']}#{tid} (step {step}): {e!r}") from e
-            if debug:
-                log.info("[task] %s#%d stage=%s %.3f ms", task["name"],
-                         tid, s, (time.perf_counter() - t_task0) * 1e3)
-        self._join_sends()
-        self.raw.clear_step(step)
-        # ONE host round trip for all micro losses.
-        out = {"losses": ([float(x) for x in
-                           jax.device_get(jnp.stack(losses))]
-                          if losses else [])}
+        # Spans ARE the timing mechanism (debug implies tracing — the log
+        # lines below read the span's measured duration).
+        with span("run_step", cat="step", step=step,
+                  worker=self.task_index) as sp_step:
+            for task in self.tasks:
+                tt = task["type"]
+                tid = task["node_id"]
+                s = task["stage"]
+                with span(task["name"], cat=tt, stage=s,
+                          micro=task.get("micro"), step=step) as sp:
+                    try:
+                        self._run_one(task, tt, tid, s, step, outputs,
+                                      losses, stage_args, sp)
+                    except TimeoutError:
+                        self._abandon_step(step)
+                        raise
+                    except Exception as e:  # noqa: BLE001 — task context
+                        self._abandon_step(step)
+                        raise RuntimeError(
+                            f"worker {self.task_index} failed at task "
+                            f"{task['name']}#{tid} (step {step}): {e!r}"
+                        ) from e
+                if debug:
+                    log.info("[task] %s#%d stage=%s %.3f ms", task["name"],
+                             tid, s, sp.dur_ms)
+            self._join_sends()
+            self.raw.clear_step(step)
+            # ONE host round trip for all micro losses.
+            out = {"losses": ([float(x) for x in
+                               jax.device_get(jnp.stack(losses))]
+                              if losses else [])}
+        metrics().counter("worker_steps").inc()
         if debug:
             log.info("[run_step] worker=%d step=%d %.3f ms",
-                     self.task_index, step,
-                     (time.perf_counter() - t_step0) * 1e3)
+                     self.task_index, step, sp_step.dur_ms)
         return out
 
     def _run_one(self, task, tt, tid, s, step, outputs, losses,
-                 stage_args) -> None:
+                 stage_args, sp=_NULL_SPAN) -> None:
         if True:  # keeps the original dispatch chain intact below
             if tt == "compute" and task["name"].startswith("fwd"):
                 outs = self.stages[s].forward(*stage_args(task))
@@ -374,6 +388,9 @@ class WorkerPlan:
                 if route is not None:
                     peer_worker, key = route
                     key = f"{key}:{step}"
+                    nb = _nbytes(val)
+                    sp.set(bytes=nb, peer=peer_worker)
+                    metrics().counter("transport_bytes_out").inc(nb)
                     if peer_worker == self.task_index:
                         self.raw.put(key, val)
                     elif self._device_xfer and self._send_device_direct(
@@ -420,6 +437,9 @@ class WorkerPlan:
                         # fwd AND remat bwd re-read this key; a pull is
                         # single-use, so park the value instead.
                         self.raw.put(key, val)
+                    nb = _nbytes(val)
+                    sp.set(bytes=nb)
+                    metrics().counter("transport_bytes_in").inc(nb)
                     outputs[tid] = (self._place_local(val),)
             elif tt == "ga_init":
                 outputs[tid] = (self.stages[s].gainit(),)

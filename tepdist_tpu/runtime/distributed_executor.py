@@ -504,6 +504,19 @@ class DistributedPipelineSession:
         for c in self.clients.values():
             c.do_remote_restore(global_step=global_step)
 
+    def dump_trace(self, path=None, clear: bool = False):
+        """Pull every worker's span buffer + metrics (GetTelemetry),
+        clock-align them (NTP-midpoint offset from the round-trip), and
+        write ONE merged Perfetto-loadable timeline — the fleet view the
+        one-off fleet_overhead_probe reconstructed by hand. ``path=None``
+        lands in ``$TEPDIST_DUMP_DIR``; returns the written path or None.
+        Dead workers are skipped, not fatal."""
+        from tepdist_tpu.telemetry import dump_merged_trace
+        live = [c for ti, c in sorted(self.clients.items())
+                if ti not in self.health.dead]
+        return dump_merged_trace(live, path=path, name="trace",
+                                 clear=clear)
+
     @classmethod
     def resume(cls, prog, cluster, params_template, optimizer=None,
                learning_rate=0.01, global_step: int = -1

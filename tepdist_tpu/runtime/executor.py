@@ -36,8 +36,19 @@ from tepdist_tpu.runtime.execution_plan import (
 )
 from tepdist_tpu.runtime.task_graph import TaskDAG, TaskType
 from tepdist_tpu.runtime.task_scheduler import ScheduleResult, TaskScheduler
+from tepdist_tpu.telemetry import _NULL_SPAN, metrics, span, tracer
 
 log = logging.getLogger(__name__)
+
+# Span category per task type (Perfetto's category filter slices by these).
+_SPAN_CAT = {
+    TaskType.COMPUTE: "compute",
+    TaskType.SEND: "send",
+    TaskType.RECV: "recv",
+    TaskType.GAINIT: "ga",
+    TaskType.GA: "ga",
+    TaskType.APPLY: "apply",
+}
 
 
 class PipelineExecutable:
@@ -628,11 +639,13 @@ class PipelineExecutable:
 
         With DEBUG on, per-task wall-clock is logged with task/stage/micro
         ids (reference: DEBUG-gated NowMicros timing around every task,
-        virtual_client.cc:1672-1803)."""
-        import time as _time
-
+        virtual_client.cc:1672-1803) — read from the task's span (DEBUG
+        implies tracing; spans are THE timing mechanism)."""
         debug = ServiceEnv.get().debug
-        t_step0 = _time.perf_counter()
+        tracing = tracer().enabled
+        sp_step = (span("pipeline_step", cat="step",
+                        step=self.global_step).__enter__()
+                   if tracing else _NULL_SPAN)
         prog = self.prog
         S = prog.num_stages
         M = prog.num_micro_batches
@@ -685,8 +698,10 @@ class PipelineExecutable:
         for tid in self.schedule.order:
             node = self.dag.node(tid)
             tt = node.task_type
-            t_task0 = _time.perf_counter() if debug else 0.0
             s, m = node.stage, node.micro
+            sp = (span(node.name, cat=_SPAN_CAT.get(tt, "data"),
+                       stage=s, micro=m).__enter__()
+                  if tracing else _NULL_SPAN)
             if tt in (TaskType.SPLIT, TaskType.INPUT, TaskType.MERGE):
                 outputs[tid] = ()
             elif tt == TaskType.COMPUTE and node.name.startswith("fwd"):
@@ -748,10 +763,15 @@ class PipelineExecutable:
                 outputs[tid] = ()
             else:
                 outputs[tid] = ()
+            if tracing:
+                if tt in (TaskType.SEND, TaskType.RECV):
+                    sp.set(bytes=sum(
+                        int(getattr(v, "nbytes", 0) or 0)
+                        for v in outputs.get(tid, ())))
+                sp.__exit__(None, None, None)
             if debug:
                 log.info("[task] %s stage=%d micro=%d %.3f ms",
-                         node.key(), node.stage, node.micro,
-                         (_time.perf_counter() - t_task0) * 1e3)
+                         node.key(), node.stage, node.micro, sp.dur_ms)
             # GC: free buffers whose last consumer just ran.
             for rid in node.mem_to_release:
                 outputs.pop(rid, None)
@@ -759,9 +779,12 @@ class PipelineExecutable:
         self.global_step += 1
         # ONE host round trip for all micro losses.
         loss = float(np.sum(jax.device_get(jnp.stack(losses)))) / M
+        metrics().counter("pipeline_steps").inc()
+        if tracing:
+            sp_step.__exit__(None, None, None)
         if debug:
             log.info("[ExecutePlan Duration] step=%d %.3f ms",
-                     self.global_step, (_time.perf_counter() - t_step0) * 1e3)
+                     self.global_step, sp_step.dur_ms)
         return loss
 
     def _apply_stage(self, s: int, acc: Tuple, M: int,

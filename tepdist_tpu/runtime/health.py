@@ -35,6 +35,7 @@ class HealthMonitor:
         self.misses: Dict[int, int] = {ti: 0 for ti in clients}
         self.dead: set = set()
         self.last_seen: Dict[int, float] = {}
+        self.last_rtt_ms: Dict[int, float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -48,13 +49,20 @@ class HealthMonitor:
                 continue
             try:
                 from tepdist_tpu.rpc import protocol
+                from tepdist_tpu.telemetry import metrics
+                t0 = time.perf_counter()
                 resp = client.stub.call("Ping", protocol.pack({}),
                                         timeout=self.timeout)
+                rtt_ms = (time.perf_counter() - t0) * 1e3
                 header, _ = protocol.unpack(resp)
                 ok = bool(header.get("ok"))
                 if ok:
                     self.misses[ti] = 0
                     self.last_seen[ti] = time.time()
+                    self.last_rtt_ms[ti] = rtt_ms
+                    m = metrics()
+                    m.gauge(f"heartbeat_rtt_ms:{ti}").set(rtt_ms)
+                    m.histogram("heartbeat_rtt_ms").observe(rtt_ms)
                 status[ti] = ok
             except Exception as e:  # noqa: BLE001
                 self.misses[ti] = self.misses.get(ti, 0) + 1

@@ -1,0 +1,37 @@
+"""Unified telemetry: span tracing, metrics registry, Perfetto export.
+
+Usage::
+
+    from tepdist_tpu.telemetry import span, metrics
+
+    with span("compute:fwd", cat="compute", stage=0) as sp:
+        ...work...
+        sp.set(bytes=n)
+    metrics().counter("steps").inc()
+
+Spans are gated by ``TEPDIST_TRACE`` (or ``DEBUG``) and cost one branch
+when disabled; metrics are always on. ``GetTelemetry`` (rpc/protocol.py)
+pulls both from every worker; ``session.dump_trace()`` merges them into
+one Perfetto-loadable timeline.
+"""
+
+from tepdist_tpu.telemetry.metrics import (  # noqa: F401
+    MetricsRegistry,
+    metrics,
+)
+from tepdist_tpu.telemetry.trace import (  # noqa: F401
+    _NULL_SPAN,
+    Span,
+    Tracer,
+    configure,
+    enabled,
+    span,
+    tracer,
+)
+from tepdist_tpu.telemetry.export import (  # noqa: F401
+    CLIENT_PID,
+    build_trace,
+    dump_merged_trace,
+    to_chrome_events,
+    write_trace,
+)

@@ -1,0 +1,136 @@
+"""Chrome-trace-event exporter + cross-worker merge.
+
+Produces the JSON object format documented for ``chrome://tracing`` /
+Perfetto: ``{"traceEvents": [...], "displayTimeUnit": "ms"}`` where each
+complete span is a ``ph: "X"`` event with microsecond ``ts``/``dur``.
+Mapping: ``pid`` = worker task_index (-1 = the client/master process),
+``tid`` = recording thread, ``cat`` = task kind — so Perfetto's process
+tracks line up with the fleet and its category filter slices by task type.
+
+Cross-worker clock alignment: each worker's ``GetTelemetry`` response
+carries ``now_us`` (its epoch clock when it answered). The caller brackets
+the RPC with its own clock (t0, t1) and estimates
+``offset_us = now_us - (t0 + t1) / 2`` — the classic NTP midpoint, accurate
+to half the round-trip. Subtracting the offset from that worker's span
+timestamps puts every process on the client's clock before merging.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+from tepdist_tpu.telemetry.metrics import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+CLIENT_PID = -1
+
+
+def to_chrome_events(spans: Iterable[Dict[str, Any]], pid: int,
+                     offset_us: float = 0.0,
+                     label: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Convert tracer snapshot records to trace events on a common clock."""
+    tids: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = []
+    if label:
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+    for sp in spans:
+        tname = sp.get("tid", "main")
+        tid = tids.get(tname)
+        if tid is None:
+            tid = len(tids)
+            tids[tname] = tid
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": tname}})
+        ev = {"name": sp["name"], "cat": sp.get("cat", "misc"), "ph": "X",
+              "ts": sp["ts"] - offset_us, "dur": sp.get("dur", 0.0),
+              "pid": pid, "tid": tid}
+        if sp.get("args"):
+            ev["args"] = sp["args"]
+        events.append(ev)
+    return events
+
+
+def build_trace(payloads: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-process telemetry payloads into one trace object.
+
+    Each payload: ``{"pid": int, "label": str, "spans": [...],
+    "offset_us": float, "metrics": snapshot-or-None}``.
+    """
+    events: List[Dict[str, Any]] = []
+    snaps: List[Dict[str, Any]] = []
+    for p in payloads:
+        events.extend(to_chrome_events(
+            p.get("spans", ()), pid=p["pid"],
+            offset_us=p.get("offset_us", 0.0), label=p.get("label")))
+        if p.get("metrics"):
+            snaps.append(p["metrics"])
+    trace: Dict[str, Any] = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if snaps:
+        trace["metadata"] = {"metrics": MetricsRegistry.merge(snaps)}
+    return trace
+
+
+def write_trace(trace: Dict[str, Any], path: Optional[str] = None,
+                name: str = "trace") -> Optional[str]:
+    """Write a trace object as JSON.
+
+    With an explicit ``path`` the file is written there (parent dirs
+    created). Otherwise it lands in ``$TEPDIST_DUMP_DIR`` via the
+    core/debug_dump.py policy — same contract as every other dump: a
+    failure to write never breaks the caller (returns None).
+    """
+    text = json.dumps(trace, separators=(",", ":"))
+    if path is None:
+        from tepdist_tpu.core import debug_dump
+        return debug_dump.write_dump(f"{name}.json", text)
+    try:
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text)
+        return path
+    except OSError:
+        return None
+
+
+def worker_payload(client, clear: bool = False) -> Dict[str, Any]:
+    """One worker's GetTelemetry pull, shaped for ``build_trace``."""
+    h = client.get_telemetry(clear=clear)
+    ti = int(h.get("task_index", 0))
+    return {"pid": ti, "label": f"worker{ti}",
+            "spans": h.get("spans", ()),
+            "offset_us": h.get("offset_us", 0.0),
+            "metrics": h.get("metrics")}
+
+
+def local_payload(label: str = "client") -> Dict[str, Any]:
+    """This process's own tracer/registry (the master/client timeline)."""
+    from tepdist_tpu.telemetry import metrics as _metrics
+    from tepdist_tpu.telemetry import trace as _trace
+    return {"pid": CLIENT_PID, "label": label,
+            "spans": _trace.tracer().snapshot(),
+            "offset_us": 0.0,
+            "metrics": _metrics().snapshot()}
+
+
+def dump_merged_trace(clients, path: Optional[str] = None,
+                      name: str = "trace", include_local: bool = True,
+                      clear: bool = False) -> Optional[str]:
+    """Pull every worker's telemetry, clock-align, and write one merged
+    Perfetto-loadable trace. An unreachable worker is skipped (its track
+    is simply absent) — dumping diagnostics never breaks the session."""
+    payloads: List[Dict[str, Any]] = []
+    if include_local:
+        payloads.append(local_payload())
+    for c in clients:
+        try:
+            payloads.append(worker_payload(c, clear=clear))
+        except Exception as e:  # noqa: BLE001 — best-effort per worker
+            log.warning("GetTelemetry failed for %s: %r",
+                        getattr(getattr(c, "stub", None), "address", "?"), e)
+    return write_trace(build_trace(payloads), path=path, name=name)

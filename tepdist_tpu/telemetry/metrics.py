@@ -1,0 +1,158 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+Reference parity: NONE (deliberate surplus — see telemetry/trace.py).
+The registry is always on (unlike spans): metric updates are a dict write
+under the GIL, cheap enough to leave unconditional, and counters like
+``transfers_parked`` / ``involuntary_remat`` must be visible even when
+nobody asked for a timeline.
+
+``snapshot()`` returns a plain-JSON dict that travels inside the
+``GetTelemetry`` response header; ``merge()`` folds snapshots from many
+workers into one fleet view (counters/histograms add, gauges keep the
+max — a merged gauge has no single true value, and max is the
+conservative read for the RTT/lag gauges this repo records).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, Optional
+
+
+class Counter:
+    """Monotonic counter."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """Last-written value."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value: Optional[float] = None
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Histogram:
+    """Streaming count/sum/min/max — enough for latency attribution
+    without committing to a bucket layout on the wire."""
+
+    __slots__ = ("count", "sum", "min", "max", "_lock")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+
+    def to_dict(self) -> Dict[str, Any]:
+        mean = self.sum / self.count if self.count else 0.0
+        return {"count": self.count, "sum": self.sum, "mean": mean,
+                "min": self.min, "max": self.max}
+
+
+class MetricsRegistry:
+    """Named get-or-create registry; all maps are keyed by metric name."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter())
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge())
+        return g
+
+    def histogram(self, name: str) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(name, Histogram())
+        return h
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "counters": {k: c.value for k, c in self._counters.items()},
+                "gauges": {k: g.value for k, g in self._gauges.items()},
+                "histograms": {k: h.to_dict()
+                               for k, h in self._histograms.items()},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+    @staticmethod
+    def merge(snapshots: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+        """Fold many ``snapshot()`` dicts into one fleet-wide view."""
+        counters: Dict[str, int] = {}
+        gauges: Dict[str, float] = {}
+        hists: Dict[str, Dict[str, Any]] = {}
+        for snap in snapshots:
+            if not snap:
+                continue
+            for k, v in snap.get("counters", {}).items():
+                counters[k] = counters.get(k, 0) + v
+            for k, v in snap.get("gauges", {}).items():
+                if v is None:
+                    continue
+                if k not in gauges or v > gauges[k]:
+                    gauges[k] = v
+            for k, h in snap.get("histograms", {}).items():
+                cur = hists.get(k)
+                if cur is None:
+                    hists[k] = dict(h)
+                    continue
+                cur["count"] += h["count"]
+                cur["sum"] += h["sum"]
+                for fn, key in ((min, "min"), (max, "max")):
+                    vals = [x for x in (cur[key], h[key]) if x is not None]
+                    cur[key] = fn(vals) if vals else None
+                cur["mean"] = (cur["sum"] / cur["count"]
+                               if cur["count"] else 0.0)
+        return {"counters": counters, "gauges": gauges, "histograms": hists}
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def metrics() -> MetricsRegistry:
+    """The process-wide registry."""
+    return _REGISTRY

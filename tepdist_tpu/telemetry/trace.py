@@ -1,0 +1,201 @@
+"""Thread-safe, ring-buffered span recorder.
+
+Reference parity: NONE — the reference ships no tracing layer; its timing
+evidence is scattered ``VLOG`` lines. This module is the permanent home for
+the cross-worker step timeline that one-off probes (tools/
+fleet_overhead_probe.py) used to reconstruct by hand.
+
+Design contract:
+
+* ``span(name, cat, **attrs)`` is a context manager. When tracing is
+  disabled it returns a shared ``_NULL_SPAN`` singleton — no Span object
+  is allocated and ``__enter__``/``__exit__`` are empty methods, so
+  instrumented hot paths cost one attribute load + one truth test per
+  call. Tests assert the identity directly (``span(...) is _NULL_SPAN``).
+* Enabled spans record wall timestamps as **epoch microseconds**
+  (``time.time_ns() // 1000``) so buffers from different processes are
+  comparable after clock alignment, while durations come from
+  ``perf_counter_ns`` (monotonic, immune to NTP steps).
+* The buffer is a ``collections.deque(maxlen=capacity)``: appends are
+  GIL-atomic, old spans fall off the front, and a runaway step cannot
+  grow memory unboundedly. Capacity comes from ``TEPDIST_TRACE_CAPACITY``.
+* Gating: ``TEPDIST_TRACE`` in core/service_env.py. ``DEBUG`` mode
+  implies tracing — the debug log lines in executor.py / worker_plan.py /
+  rpc/server.py read their durations from spans, so spans are THE timing
+  mechanism, not a parallel one.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+class _NullSpan:
+    """Shared no-op span: the disabled-mode fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **attrs) -> "_NullSpan":
+        return self
+
+    @property
+    def dur_us(self) -> float:
+        return 0.0
+
+    @property
+    def dur_ms(self) -> float:
+        return 0.0
+
+    @property
+    def elapsed_ms(self) -> float:
+        return 0.0
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One recorded interval. Created only when tracing is enabled."""
+
+    __slots__ = ("name", "cat", "attrs", "ts_us", "_t0", "_dur_us", "_tracer")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str,
+                 attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.attrs = attrs
+        self.ts_us = 0
+        self._t0 = 0
+        self._dur_us = 0.0
+
+    def __enter__(self) -> "Span":
+        self.ts_us = time.time_ns() // 1000
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._dur_us = (time.perf_counter_ns() - self._t0) / 1e3
+        self._tracer._record(self)
+        return False
+
+    def set(self, **attrs) -> "Span":
+        """Attach attributes mid-span (byte counts known after the work)."""
+        self.attrs.update(attrs)
+        return self
+
+    @property
+    def dur_us(self) -> float:
+        return self._dur_us
+
+    @property
+    def dur_ms(self) -> float:
+        return self._dur_us / 1e3
+
+    @property
+    def elapsed_ms(self) -> float:
+        """Live elapsed time (readable inside the with-block — this is
+        what the debug log lines print, making spans THE timing source)."""
+        return (time.perf_counter_ns() - self._t0) / 1e6
+
+
+class Tracer:
+    """Ring buffer of finished spans for one process."""
+
+    def __init__(self, capacity: int = 65536, enabled: bool = False):
+        self.enabled = enabled
+        self.capacity = capacity
+        self._buf: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def _record(self, sp: Span) -> None:
+        th = threading.current_thread()
+        # deque.append is GIL-atomic; the dict is the export-ready record.
+        self._buf.append({
+            "name": sp.name,
+            "cat": sp.cat,
+            "ts": sp.ts_us,
+            "dur": sp.dur_us,
+            "tid": th.name,
+            "args": sp.attrs,
+        })
+
+    def snapshot(self, clear: bool = False) -> List[Dict[str, Any]]:
+        """Copy out the buffered spans (optionally draining the ring)."""
+        with self._lock:
+            out = list(self._buf)
+            if clear:
+                self._buf.clear()
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+
+_TRACER: Optional[Tracer] = None
+_INIT_LOCK = threading.Lock()
+
+
+def _init_from_env() -> Tracer:
+    global _TRACER
+    with _INIT_LOCK:
+        if _TRACER is None:
+            from tepdist_tpu.core.service_env import ServiceEnv
+            env = ServiceEnv.get()
+            _TRACER = Tracer(
+                capacity=max(1, int(env.tepdist_trace_capacity)),
+                enabled=bool(env.tepdist_trace) or bool(env.debug),
+            )
+    return _TRACER
+
+
+def tracer() -> Tracer:
+    """The process-wide tracer (lazily configured from ServiceEnv)."""
+    t = _TRACER
+    if t is None:
+        t = _init_from_env()
+    return t
+
+
+def configure(enabled: Optional[bool] = None,
+              capacity: Optional[int] = None) -> Tracer:
+    """Explicit (re)configuration — tests and entry points that change
+    ServiceEnv after import call this; a capacity change re-rings the
+    buffer (dropping buffered spans)."""
+    global _TRACER
+    with _INIT_LOCK:
+        t = _TRACER
+        if t is None or (capacity is not None and capacity != t.capacity):
+            t = Tracer(capacity=capacity if capacity is not None else 65536,
+                       enabled=t.enabled if t is not None else False)
+            _TRACER = t
+        if enabled is not None:
+            t.enabled = enabled
+    return t
+
+
+def enabled() -> bool:
+    return tracer().enabled
+
+
+def span(name: str, cat: str = "misc", **attrs):
+    """Start a span. Returns the shared no-op singleton when disabled."""
+    t = _TRACER
+    if t is None:
+        t = _init_from_env()
+    if not t.enabled:
+        return _NULL_SPAN
+    return Span(t, name, cat, attrs)

@@ -207,10 +207,12 @@ def plan_training(
     if (not explore and env.opt_level >= 2 and topology is None
             and num_stages is None):
         explore = True
+    explored_winner = None
     if explore and topology is None and num_stages is None:
         best = explore_parallelism(
             loss_fn, params, *example_batch, n_devices=len(devices),
             num_micro_batches=num_micro_batches or 4)
+        explored_winner = best
         if best["kind"] == "pipeline":
             num_stages = best["num_stages"]
             num_micro_batches = best["num_micro_batches"]
@@ -337,6 +339,28 @@ def plan_training(
         step_fn, topology, params, opt_state, *example_batch,
         annotations=annotations, mode=mode, state_alias=state_alias,
         var_mem_limit=var_mem_limit)
+    # Winner-only lowering post-check (NOTES_NEXT gap #2): the search loop
+    # cannot afford a compile per candidate, but the CHOSEN plan compiles
+    # anyway — lowering_diagnostics uses the same state-donating jit
+    # _SpmdTrainingPlan steps with, so the diagnostic compile is cached
+    # and the first real step pays nothing extra.
+    if explored_winner is not None and env.lowering_postcheck:
+        from tepdist_tpu.telemetry import metrics
+        try:
+            remats = plan.lowering_diagnostics(devices=devices)
+        except Exception as e:  # noqa: BLE001 — diagnostics only
+            log.warning("lowering post-check failed: %r", e)
+        else:
+            if remats:
+                metrics().counter("involuntary_remat").inc(len(remats))
+                log.warning(
+                    "explore winner %r (axes=%s): XLA reported %d "
+                    "involuntary full rematerialization(s) (%s) — the "
+                    "chosen sharding forces recompute the cost model did "
+                    "not price; consider a different topology",
+                    explored_winner["kind"],
+                    list(topology.device_axes()), len(remats),
+                    ", ".join(remats[:3]))
     n_batch_leaves = len(jax.tree_util.tree_leaves(example_batch))
     return _SpmdTrainingPlan(plan, params, opt_state, n_batch_leaves,
                              devices)

@@ -122,7 +122,12 @@ def test_streaming_save_bounded_host_residency(tmp_path, devices, monkeypatch):
 
     def tracking_fetch(value):
         gc.collect()    # give the writer's del its effect before counting
-        arr = orig_fetch(value)
+        # Force an owning copy: on CPU jax device_get is a zero-copy view
+        # cached on the Array (no extra residency, but also never freed
+        # while `variables` lives). The copy is what a real device backend
+        # would hand back, so the writer's drop-before-next-fetch is what
+        # gets measured.
+        arr = np.array(orig_fetch(value))
         token = id(arr)
         alive.add(token)
         weakref.finalize(arr, alive.discard, token)

@@ -8,6 +8,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+from tepdist_tpu.core.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -22,7 +24,7 @@ def test_psum_all_reduce(mesh):
     def f(x):
         return jax.lax.psum(x, "x")
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=P("x"), out_specs=P("x"))(x)
+    out = shard_map(f, mesh=mesh, in_specs=P("x"), out_specs=P("x"))(x)
     # Each shard holds the sum of all shards: 0+1+...+7 = 28.
     np.testing.assert_array_equal(np.asarray(out), np.full((8,), 28.0))
 
@@ -33,7 +35,7 @@ def test_all_gather(mesh):
     def f(x):
         return jax.lax.all_gather(x, "x", axis=0, tiled=True)
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=P("x", None),
+    out = shard_map(f, mesh=mesh, in_specs=P("x", None),
                         out_specs=P("x", None))(x)
     assert out.shape == (64, 1)
     np.testing.assert_array_equal(np.asarray(out)[:8, 0], np.arange(8.0))
@@ -48,7 +50,7 @@ def test_all_to_all(mesh):
         return jax.lax.all_to_all(x, "x", split_axis=1, concat_axis=0,
                                   tiled=True)
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=P("x", None),
+    out = shard_map(f, mesh=mesh, in_specs=P("x", None),
                         out_specs=P("x", None))(x)
     # Device d ends up holding column d: global (64, 1) stacking columns.
     assert out.shape == (64, 1)
@@ -64,7 +66,7 @@ def test_ppermute_ring(mesh):
         perm = [(i, (i + 1) % 8) for i in range(8)]
         return jax.lax.ppermute(x, "x", perm)
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=P("x"), out_specs=P("x"))(x)
+    out = shard_map(f, mesh=mesh, in_specs=P("x"), out_specs=P("x"))(x)
     np.testing.assert_array_equal(np.asarray(out),
                                   np.roll(np.arange(8.0), 1))
 
@@ -75,7 +77,7 @@ def test_reduce_scatter(mesh):
     def f(x):  # [1, 8] per device
         return jax.lax.psum_scatter(x, "x", scatter_dimension=1, tiled=True)
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=P("x", None),
+    out = shard_map(f, mesh=mesh, in_specs=P("x", None),
                         out_specs=P("x", None))(x)
     np.testing.assert_array_equal(np.asarray(out), np.full((8, 1), 8.0))
 

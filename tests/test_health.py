@@ -1,0 +1,95 @@
+"""HealthMonitor unit tests with fake clients (no server subprocesses —
+live-fleet coverage is test_multiworker.py). Covers the miss -> dead ->
+assert_healthy escalation, the on_failure callback contract, miss-count
+reset on recovery, and the heartbeat RTT gauge/histogram."""
+
+import pytest
+
+from tepdist_tpu.rpc import protocol
+from tepdist_tpu.runtime.health import HealthMonitor
+from tepdist_tpu.telemetry import metrics
+
+
+class _FakeStub:
+    """Scriptable Ping endpoint: pops the next behaviour per call."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+
+    def call(self, method, payload, timeout=None):
+        assert method == "Ping"
+        self.calls += 1
+        beh = self.script.pop(0) if self.script else "ok"
+        if beh == "ok":
+            return protocol.pack({"ok": True})
+        if beh == "notok":
+            return protocol.pack({"ok": False})
+        raise ConnectionError("fake heartbeat failure")
+
+
+class _FakeClient:
+    def __init__(self, script=()):
+        self.stub = _FakeStub(script)
+
+
+def test_all_healthy_resets_misses_and_records_rtt():
+    metrics().reset()
+    clients = {0: _FakeClient(), 1: _FakeClient()}
+    mon = HealthMonitor(clients, max_misses=2)
+    mon.misses[1] = 1  # a prior transient miss...
+    status = mon.check_once()
+    assert status == {0: True, 1: True}
+    assert mon.misses == {0: 0, 1: 0}  # ...cleared by the successful Ping
+    assert mon.healthy() and not mon.dead
+    mon.assert_healthy()  # must not raise
+    assert mon.last_rtt_ms[0] > 0.0 and mon.last_rtt_ms[1] > 0.0
+    snap = metrics().snapshot()
+    assert snap["gauges"]["heartbeat_rtt_ms:0"] == mon.last_rtt_ms[0]
+    assert snap["gauges"]["heartbeat_rtt_ms:1"] == mon.last_rtt_ms[1]
+    assert snap["histograms"]["heartbeat_rtt_ms"]["count"] == 2
+
+
+def test_misses_accumulate_then_dead_then_raise():
+    failures = []
+    clients = {0: _FakeClient(), 1: _FakeClient(["raise", "raise", "raise"])}
+    mon = HealthMonitor(clients, max_misses=2,
+                        on_failure=lambda ti, e: failures.append((ti, e)))
+    assert mon.check_once() == {0: True, 1: False}
+    assert mon.misses[1] == 1 and not mon.dead and failures == []
+    assert mon.check_once() == {0: True, 1: False}
+    assert 1 in mon.dead
+    assert [ti for ti, _ in failures] == [1]
+    assert isinstance(failures[0][1], ConnectionError)
+    # Once dead, the worker is not pinged again (2 failing calls, not 3).
+    mon.check_once()
+    assert clients[1].stub.calls == 2
+    assert not mon.healthy()
+    with pytest.raises(RuntimeError, match=r"workers \[1\] are dead"):
+        mon.assert_healthy()
+
+
+def test_not_ok_response_counts_as_unhealthy_but_not_a_miss():
+    # ok=False is an answering-but-unhealthy worker: reported False, yet
+    # only exceptions escalate toward dead.
+    mon = HealthMonitor({0: _FakeClient(["notok", "ok"])}, max_misses=1)
+    assert mon.check_once() == {0: False}
+    assert not mon.dead
+    assert mon.check_once() == {0: True}
+
+
+def test_transient_miss_recovers():
+    mon = HealthMonitor({0: _FakeClient(["raise", "ok"])}, max_misses=2)
+    assert mon.check_once() == {0: False}
+    assert mon.misses[0] == 1
+    assert mon.check_once() == {0: True}
+    assert mon.misses[0] == 0 and mon.healthy()
+
+
+def test_dead_worker_rtt_gauge_not_updated():
+    metrics().reset()
+    mon = HealthMonitor({3: _FakeClient(["raise"])}, max_misses=1)
+    mon.check_once()
+    assert 3 in mon.dead
+    assert 3 not in mon.last_rtt_ms
+    assert "heartbeat_rtt_ms:3" not in metrics().snapshot()["gauges"]

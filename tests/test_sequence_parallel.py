@@ -33,8 +33,10 @@ def test_ring_attention_matches_reference(seq_mesh, causal):
     ref = reference_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
-    # Output keeps the sequence sharding.
-    assert out.sharding.spec == P(None, None, "seq", None)
+    # Output keeps the sequence sharding. Older jax trims trailing
+    # replicated dims from the spec, so compare padded tuples.
+    spec = tuple(out.sharding.spec)
+    assert spec + (None,) * (4 - len(spec)) == (None, None, "seq", None)
 
 
 @pytest.mark.parametrize("causal", [True, False])

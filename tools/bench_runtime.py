@@ -203,6 +203,11 @@ def spawn_protocol_fleet():
         raise
 
 
+# Set by bench_two_worker_fleet when TEPDIST_TRACE=1: path of the merged
+# fleet step trace, surfaced in the runtime line by run().
+_FLEET_TRACE_PATH = [None]
+
+
 def bench_two_worker_fleet() -> float:
     """SAME protocol config over a 2-PROCESS fleet (one server process
     per stage, 1 device each): the multi-worker task-graph path on its
@@ -214,6 +219,14 @@ def bench_two_worker_fleet() -> float:
     sess, tokens, procs = spawn_protocol_fleet()
     try:
         ms = _timed_ms_per_step(lambda: sess.step(tokens))
+        if os.environ.get("TEPDIST_TRACE"):
+            # Workers inherit TEPDIST_TRACE through spawn_protocol_fleet's
+            # env copy, so this pulls real spans from every stage server
+            # and writes one clock-aligned timeline next to the bench JSON
+            # (feed it to tools/trace_summary.py).
+            root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            _FLEET_TRACE_PATH[0] = sess.dump_trace(
+                os.path.join(root, "bench_trace.json"))
         sess.close()
         return ms
     finally:
@@ -316,6 +329,8 @@ def run() -> dict:
         # (numerics-exactness asserted in tests/test_pp_tp_depth.py).
         "pp_tp_depth_ms": None if depth_ms is None else round(depth_ms, 2),
     }
+    if _FLEET_TRACE_PATH[0]:
+        line["fleet_trace"] = _FLEET_TRACE_PATH[0]
     if task_ms is not None and coll_ms is not None:
         best = min(task_ms, coll_ms)
         line["value"] = round(best, 2)

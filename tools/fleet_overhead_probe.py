@@ -21,6 +21,13 @@ of which holds on a 1-core CPU host.
 
 Run: python tools/fleet_overhead_probe.py  (prints one JSON report and
 writes fleet_overhead_probe.json next to bench_extra.json).
+
+SUPERSEDED for routine use by the permanent telemetry layer: run with
+TEPDIST_TRACE=1, call ``session.dump_trace()`` and feed the merged trace
+to ``tools/trace_summary.py`` for per-category time, per-worker busy
+fraction, and the bubble estimate. This probe stays for the one thing
+spans can't see: per-process CPU CYCLES from /proc (the 1-core
+serialization verdict).
 """
 
 from __future__ import annotations

@@ -1,0 +1,132 @@
+"""Summarize a merged tepdist trace: where did the step time go?
+
+Reads a Chrome-trace-event JSON file (the output of
+``session.dump_trace()`` / ``DistributedPipelineSession.dump_trace()``,
+telemetry/export.py) and prints:
+
+  * per-category time (compute / send / recv / ga / apply / rpc / planner),
+  * per-worker busy fraction (union of task spans over the worker's
+    active window — envelope spans like run_step/rpc don't count as busy),
+  * a pipeline-bubble estimate per worker (1 - compute-busy / window),
+    the quantity JaxPP-style pipeline claims are attributed with.
+
+This is the permanent CLI replacement for the one-off
+tools/fleet_overhead_probe.py analysis (the probe measured CPU cycles for
+one verdict; this reads any recorded timeline).
+
+Run: python tools/trace_summary.py TRACE.json [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any, Dict, List, Tuple
+
+# Envelope categories: they CONTAIN task spans, so counting them toward
+# busy time would make every worker look 100% occupied.
+ENVELOPE_CATS = {"step", "rpc"}
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        trace = json.load(f)
+    if "traceEvents" not in trace:
+        raise ValueError(f"{path}: not a trace-event JSON object")
+    return trace
+
+
+def _union_ms(intervals: List[Tuple[float, float]]) -> float:
+    """Total covered time (ms) of possibly-overlapping [t0, t1) us spans."""
+    total = 0.0
+    end = None
+    for t0, t1 in sorted(intervals):
+        if end is None or t0 > end:
+            total += t1 - t0
+            end = t1
+        elif t1 > end:
+            total += t1 - end
+            end = t1
+    return total / 1e3
+
+
+def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
+    events = [e for e in trace.get("traceEvents", ())
+              if e.get("ph") == "X"]
+    proc_names = {e["pid"]: e["args"]["name"]
+                  for e in trace.get("traceEvents", ())
+                  if e.get("ph") == "M"
+                  and e.get("name") == "process_name"}
+
+    by_cat: Dict[str, float] = {}
+    per_pid: Dict[Any, Dict[str, List[Tuple[float, float]]]] = {}
+    for e in events:
+        cat = e.get("cat", "misc")
+        dur = float(e.get("dur", 0.0))
+        by_cat[cat] = by_cat.get(cat, 0.0) + dur / 1e3
+        b = per_pid.setdefault(e["pid"], {"task": [], "compute": [],
+                                          "all": []})
+        iv = (float(e["ts"]), float(e["ts"]) + dur)
+        b["all"].append(iv)
+        if cat not in ENVELOPE_CATS:
+            b["task"].append(iv)
+        if cat == "compute":
+            b["compute"].append(iv)
+
+    workers = {}
+    for pid, b in sorted(per_pid.items()):
+        if not b["all"]:
+            continue
+        t_lo = min(t0 for t0, _ in b["all"])
+        t_hi = max(t1 for _, t1 in b["all"])
+        window_ms = (t_hi - t_lo) / 1e3
+        busy_ms = _union_ms(b["task"])
+        compute_ms = _union_ms(b["compute"])
+        workers[str(pid)] = {
+            "label": proc_names.get(pid, f"pid{pid}"),
+            "window_ms": round(window_ms, 3),
+            "busy_ms": round(busy_ms, 3),
+            "busy_fraction": round(busy_ms / window_ms, 3)
+            if window_ms else 0.0,
+            "compute_ms": round(compute_ms, 3),
+            "bubble_fraction": round(1.0 - compute_ms / window_ms, 3)
+            if window_ms else None,
+        }
+    return {
+        "n_events": len(events),
+        "category_ms": {k: round(v, 3)
+                        for k, v in sorted(by_cat.items())},
+        "workers": workers,
+        "metrics": trace.get("metadata", {}).get("metrics"),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("trace_summary")
+    ap.add_argument("trace", help="merged trace JSON (session.dump_trace)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as JSON instead of text")
+    args = ap.parse_args()
+    s = summarize(load_trace(args.trace))
+    if args.json:
+        print(json.dumps(s, indent=1))
+        return
+    print(f"{s['n_events']} spans")
+    print("per-category time:")
+    for cat, ms in s["category_ms"].items():
+        print(f"  {cat:<12} {ms:10.3f} ms")
+    print("per-worker:")
+    for pid, w in s["workers"].items():
+        bubble = (f"  bubble={w['bubble_fraction']:.1%}"
+                  if w["bubble_fraction"] is not None else "")
+        print(f"  {w['label']:<10} (pid {pid}) window={w['window_ms']:.1f} "
+              f"ms busy={w['busy_fraction']:.1%}{bubble}")
+    counters = (s.get("metrics") or {}).get("counters") or {}
+    if counters:
+        print("counters:")
+        for k, v in sorted(counters.items()):
+            print(f"  {k:<28} {v}")
+
+
+if __name__ == "__main__":
+    main()
