@@ -10,6 +10,14 @@ fetches resource variables every ``FETCH_RESOURCE_VAR_STEPS`` steps.
 The JAX version traces ``step_fn(params, opt_state, *batch)`` client-side,
 serializes the inlined jaxpr, and lets the SERVER plan/compile/execute on
 its devices — the client needs no accelerator.
+
+Robustness: every RPC issued here rides ``TepdistClient.call`` and thus
+inherits rpc/retry.py's policy (per-verb deadlines, exponential backoff,
+transport-vs-fatal classification). ``run``/``run_async``'s ExecutePlan
+carries an idempotency token, so a retried step whose original response
+was lost is answered from the server's dedup cache instead of advancing
+``global_step`` twice — safe to call under lossy networks or an active
+``TEPDIST_FAULT_SPEC`` fault plan.
 """
 
 from __future__ import annotations

@@ -4,18 +4,38 @@ Reference parity: ``GRPCStub`` / ``Client`` / ``ClientLibrary`` (reference:
 rpc/grpc_stub.{h,cc}, client/client.cc:287-410, client/client_library.cc:
 142-165): channel resolved from ``SERVER_IP``/``SERVER_PORT`` env vars with
 INT_MAX message sizes; methods mirror the TePDist RPC set.
+
+Robustness deltas over the reference (which treats any gRPC error as a
+CHECK failure): every stub call runs under rpc/retry.py's policy —
+per-verb deadlines, exponential backoff + jitter, transport-vs-fatal
+classification — and consults the active fault plan (runtime/faults.py)
+so injected drops/delays exercise exactly this path. ``TepdistClient``
+attaches idempotency tokens to mutating verbs; the server dedups replays
+(an applied-but-unacknowledged request is retried safely). Addresses
+beginning with ``inproc:`` route to the in-process transport
+(rpc/inproc.py) instead of a gRPC channel.
 """
 
 from __future__ import annotations
 
+import itertools
 import os
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from tepdist_tpu.rpc import protocol
+from tepdist_tpu.rpc import protocol, retry
+from tepdist_tpu.runtime import faults
 from tepdist_tpu.telemetry import metrics, span
+
+# Mutating verbs that carry an idempotency token: a retried request whose
+# original WAS applied (response lost) must not double-apply. Everything
+# else is naturally idempotent (pure reads, or keyed puts that overwrite
+# with the same value).
+IDEMPOTENT_TOKEN_VERBS = {"ExecutePlan", "DispatchPlan",
+                          "TransferToServerHost"}
 
 
 class GRPCStub:
@@ -40,12 +60,15 @@ class GRPCStub:
             for m in protocol.METHODS
         }
 
-    def call(self, method: str, payload: bytes, timeout: float = 300.0
-             ) -> bytes:
+    def call(self, method: str, payload: bytes,
+             timeout: Optional[float] = None,
+             max_attempts: Optional[int] = None) -> bytes:
+        timeout = retry.deadline_for(method, timeout)
         t0 = time.perf_counter()
         with span(f"rpc:{method}", cat="rpc", addr=self.address,
                   req_bytes=len(payload)) as sp:
-            resp = self._methods[method](payload, timeout=timeout)
+            resp = retry.call_with_retry(self._call_once, method, payload,
+                                         timeout, max_attempts=max_attempts)
             sp.set(resp_bytes=len(resp))
         m = metrics()
         # Metrics are always on (spans are not): measure independently.
@@ -53,6 +76,19 @@ class GRPCStub:
             (time.perf_counter() - t0) * 1e3)
         m.counter(f"rpc_bytes_out:{method}").inc(len(payload))
         m.counter(f"rpc_bytes_in:{method}").inc(len(resp))
+        return resp
+
+    def _call_once(self, method: str, payload: bytes,
+                   timeout: float) -> bytes:
+        plan = faults.active()
+        action = plan.rpc_action(method) if plan is not None else None
+        if action == "drop_request":
+            raise faults.InjectedFault(
+                f"{method} request dropped", kind="rpc_drop")
+        resp = self._methods[method](payload, timeout=timeout)
+        if action == "drop_response":
+            raise faults.InjectedFault(
+                f"{method} response dropped", kind="rpc_drop")
         return resp
 
     def wait_ready(self, timeout: float = 30.0) -> None:
@@ -63,15 +99,41 @@ class GRPCStub:
         self._channel.close()
 
 
+def make_stub(address: Optional[str] = None):
+    """Transport selection: ``inproc:<port>`` addresses get the in-process
+    stub (rpc/inproc.py); everything else a gRPC channel."""
+    if address is not None and str(address).startswith("inproc:"):
+        from tepdist_tpu.rpc.inproc import InProcStub
+        return InProcStub(address)
+    return GRPCStub(address)
+
+
 class TepdistClient:
     """High-level client (reference ``Client``)."""
 
     def __init__(self, address: Optional[str] = None):
-        self.stub = GRPCStub(address)
+        self.stub = make_stub(address)
+        self._uid = uuid.uuid4().hex[:12]
+        self._idem_seq = itertools.count(1)
+
+    # -- generic call --------------------------------------------------
+    def call(self, method: str, header: Dict[str, Any],
+             blobs: Sequence[bytes] = (),
+             timeout: Optional[float] = None,
+             max_attempts: Optional[int] = None) -> bytes:
+        """Pack + send with retry. Mutating verbs get an ``idem`` token in
+        the header: the payload is packed ONCE, so every retry replays the
+        identical bytes and the server's dedup cache can recognize (and
+        answer) an already-applied request instead of re-running it."""
+        if method in IDEMPOTENT_TOKEN_VERBS and "idem" not in header:
+            header = dict(header)
+            header["idem"] = f"{self._uid}:{method}:{next(self._idem_seq)}"
+        return self.stub.call(method, protocol.pack(header, list(blobs)),
+                              timeout=timeout, max_attempts=max_attempts)
 
     # -- lifecycle ----------------------------------------------------
     def ping(self) -> Dict[str, Any]:
-        header, _ = protocol.unpack(self.stub.call("Ping", protocol.pack({})))
+        header, _ = protocol.unpack(self.call("Ping", {}))
         return header
 
     def wait_ready(self, timeout: float = 30.0) -> None:
@@ -84,8 +146,7 @@ class TepdistClient:
         half the round-trip ``rtt_us``) — subtract it from the worker's
         span timestamps to merge timelines (telemetry/export.py)."""
         t0 = time.time_ns() // 1000
-        resp = self.stub.call("GetTelemetry",
-                              protocol.pack({"clear": clear}))
+        resp = self.call("GetTelemetry", {"clear": clear})
         t1 = time.time_ns() // 1000
         header, _ = protocol.unpack(resp)
         header["rtt_us"] = t1 - t0
@@ -146,8 +207,7 @@ class TepdistClient:
                 # trace at batch/M, not a re-eval of the full-batch jaxpr.
                 options["micro_loss_module_blob"] = len(blobs)
                 blobs.append(micro_loss_module)
-        resp = self.stub.call("BuildExecutionPlan",
-                              protocol.pack({"options": options}, blobs))
+        resp = self.call("BuildExecutionPlan", {"options": options}, blobs)
         header, _ = protocol.unpack(resp)
         return header
 
@@ -155,13 +215,14 @@ class TepdistClient:
     def transfer_to_server_host(self, value, global_idx: int,
                                 variable: bool = False) -> None:
         meta, blob = protocol.encode_literal(np.asarray(value))
-        self.stub.call("TransferToServerHost", protocol.pack(
-            {"global_idx": global_idx, "variable": variable,
-             "literal": meta}, [blob]))
+        self.call("TransferToServerHost",
+                  {"global_idx": global_idx, "variable": variable,
+                   "literal": meta}, [blob])
 
     def transfer_var_arg_map(self, var_arg_map: Dict[int, int]) -> None:
-        self.stub.call("TransferVarArgMap", protocol.pack(
-            {"var_arg_map": {str(k): v for k, v in var_arg_map.items()}}))
+        self.call("TransferVarArgMap",
+                  {"var_arg_map": {str(k): v
+                                   for k, v in var_arg_map.items()}})
 
     # -- execution ----------------------------------------------------
     def execute_plan(self, handle: int,
@@ -176,10 +237,10 @@ class TepdistClient:
             inline[str(idx)] = len(blobs)
             inline_meta[str(idx)] = meta
             blobs.append(blob)
-        resp = self.stub.call("ExecutePlan", protocol.pack(
-            {"handle": handle, "inline": inline, "inline_meta": inline_meta,
-             "fetch_resource_variables": fetch_resource_variables,
-             "inference": inference}, blobs))
+        resp = self.call("ExecutePlan", {
+            "handle": handle, "inline": inline, "inline_meta": inline_meta,
+            "fetch_resource_variables": fetch_resource_variables,
+            "inference": inference}, blobs)
         header, rblobs = protocol.unpack(resp)
         outputs = [protocol.decode_literal(m, rblobs[i])
                    for i, m in enumerate(header["outputs"])]
@@ -194,8 +255,8 @@ class TepdistClient:
 
     def fetch_resource_vars(self, indices: Optional[Sequence[int]] = None
                             ) -> Dict[int, np.ndarray]:
-        resp = self.stub.call("FetchResourceVars", protocol.pack(
-            {"indices": list(indices) if indices is not None else None}))
+        resp = self.call("FetchResourceVars", {
+            "indices": list(indices) if indices is not None else None})
         header, blobs = protocol.unpack(resp)
         return {int(m["global_idx"]): protocol.decode_literal(m, blobs[i])
                 for i, m in enumerate(header["vars"])}
@@ -204,18 +265,18 @@ class TepdistClient:
     def do_remote_save(self, max_to_keep: int = 5,
                        global_step: Optional[int] = None,
                        lazy: bool = False) -> None:
-        self.stub.call("DoRemoteSave", protocol.pack(
-            {"max_to_keep": max_to_keep, "global_step": global_step,
-             "lazy": lazy}))
+        self.call("DoRemoteSave",
+                  {"max_to_keep": max_to_keep, "global_step": global_step,
+                   "lazy": lazy})
 
     def do_remote_restore(self, global_step: int = -1,
                           lazy: bool = False,
                           all_shards: bool = False) -> int:
         """Returns the restored global step (-1 when lazy: the restore is
         latched and consumed on the next ExecutePlan)."""
-        resp = self.stub.call("DoRemoteRestore", protocol.pack(
-            {"global_step": global_step, "lazy": lazy,
-             "all_shards": all_shards}))
+        resp = self.call("DoRemoteRestore",
+                         {"global_step": global_step, "lazy": lazy,
+                          "all_shards": all_shards})
         header, _ = protocol.unpack(resp)
         return int(header.get("global_step", -1))
 

@@ -23,12 +23,23 @@ RPC surface (method -> reference RPC):
   AbortStep             -> (no reference analogue: cancels an in-flight
                            ExecuteRemotePlan's recv waits so mid-step
                            worker death is detected at heartbeat latency,
-                           not RPC-timeout latency)
+                           not RPC-timeout latency; header {"reset": true}
+                           instead CLEARS the abort latch, keeping the raw
+                           store's data, so the master can re-execute the
+                           same step after a transient fault)
   Ping                  -> GetDeviceHandles (liveness/metadata)
   GetTelemetry          -> (no reference analogue: pulls the worker's span
                            ring buffer + metrics snapshot, stamped with the
                            worker's clock so the client can align fleets'
                            timelines — telemetry/export.py)
+
+Retry + idempotency (rpc/retry.py, no reference analogue): mutating verbs
+(ExecutePlan, DispatchPlan, TransferToServerHost) carry an ``idem`` header
+token — ``"<client-uid>:<method>:<seq>"`` — and the server caches each
+token's response bytes, so a retried request whose original WAS applied
+(response lost in flight) is answered from the cache instead of being
+re-run. All other verbs are naturally idempotent (pure reads or keyed puts
+that overwrite with identical values).
 """
 
 from __future__ import annotations

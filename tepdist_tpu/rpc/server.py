@@ -34,6 +34,7 @@ from tepdist_tpu.core.mesh import MeshTopology
 from tepdist_tpu.core.service_env import ServiceEnv
 from tepdist_tpu.rpc import protocol
 from tepdist_tpu.rpc.jaxpr_serde import deserialize_closed_jaxpr
+from tepdist_tpu.runtime import faults
 from tepdist_tpu.telemetry import metrics, span
 
 log = logging.getLogger("tepdist.server")
@@ -186,6 +187,16 @@ class TepdistServicer:
         # worker resuming a wedged step cannot poison the rebuilt plan's
         # data plane with stale activations (same step index, old plan).
         self.plan_gen = 0
+        # Idempotency dedup: token -> cached response bytes for mutating
+        # verbs (ExecutePlan / DispatchPlan / TransferToServerHost). A
+        # client retry whose original request WAS applied (response lost
+        # in transit) replays the same token and gets the cached answer
+        # instead of a double-applied update. Successful responses only;
+        # bounded LRU — tokens are per-(client, call), so the window only
+        # needs to cover the retry horizon, not history.
+        from collections import OrderedDict
+        self._idem_cache: "OrderedDict[str, bytes]" = OrderedDict()
+        self._idem_lock = threading.Lock()
         # Device-direct inter-worker data plane (VERDICT r3 missing #3;
         # reference: NCCL p2p Send/Recv, virtual_client.cc:2161-2192):
         # a jax transfer server serves activations device-to-device on
@@ -200,6 +211,34 @@ class TepdistServicer:
         # buffers. Freed one step behind (the master serializes steps, so
         # when this worker starts step N every step N-1 pull has landed).
         self._parked_transfers: Dict[int, List[Any]] = {}
+
+    # -- idempotency dedup (see _idem_cache in __init__) ----------------
+    _IDEM_CACHE_MAX = 128
+
+    def _idem_get(self, header) -> Optional[bytes]:
+        tok = header.get("idem")
+        if tok is None:
+            return None
+        with self._idem_lock:
+            resp = self._idem_cache.get(tok)
+        if resp is not None:
+            metrics().counter("dedup_hits").inc()
+            log.info("idempotent replay deduped: %s", tok)
+        return resp
+
+    def _idem_put(self, header, resp: bytes) -> bytes:
+        tok = header.get("idem")
+        if tok is not None:
+            with self._idem_lock:
+                self._idem_cache[tok] = resp
+                while len(self._idem_cache) > self._IDEM_CACHE_MAX:
+                    self._idem_cache.popitem(last=False)
+        return resp
+
+    def _inject_server_fault(self, verb: str) -> None:
+        plan = faults.active()
+        if plan is not None:
+            plan.server_fault(verb, self.task_index)
 
     def park_transfer(self, step: int, vals) -> None:
         with self._lock:
@@ -716,6 +755,9 @@ class TepdistServicer:
         input, keyed by global arg index (reference
         TransferToServerRequest.{variable,global_idx})."""
         header, blobs = protocol.unpack(request)
+        cached = self._idem_get(header)
+        if cached is not None:
+            return cached
         idx = int(header["global_idx"])
         arr = protocol.decode_literal(header["literal"], blobs[0])
         with self._lock:
@@ -723,13 +765,15 @@ class TepdistServicer:
                 self.variables[idx] = arr
             else:
                 self.inputs[idx] = arr
-        return protocol.pack({"ok": True, "global_idx": idx})
+        return self._idem_put(header,
+                              protocol.pack({"ok": True, "global_idx": idx}))
 
     def TransferHostRawData(self, request: bytes, context=None) -> bytes:
         """Raw-keyed per-step data (reference: per-step input slices +
         peer-to-peer activation pushes in the RPC transport)."""
         header, blobs = protocol.unpack(request)
         if "raw_key" in header or "raw_multi" in header:
+            self._inject_server_fault("TransferHostRawData")
             gen = header.get("plan_gen")
             if gen is not None and gen != self.plan_gen:
                 # Stale-plan push (see plan_gen in __init__): acknowledge
@@ -853,11 +897,16 @@ class TepdistServicer:
 
     def ExecutePlan(self, request: bytes, context=None) -> bytes:
         header, blobs = protocol.unpack(request)
+        cached = self._idem_get(header)
+        if cached is not None:
+            return cached
+        self._inject_server_fault("ExecutePlan")
         handle = int(header["handle"])
         plan = self.plan_cache.resolve(handle)
         with span("ExecutePlan", cat="rpc", handle=handle,
                   kind=plan.kind) as sp:
-            return self._execute_plan_body(plan, header, blobs, sp)
+            return self._idem_put(
+                header, self._execute_plan_body(plan, header, blobs, sp))
 
     def _execute_plan_body(self, plan, header, blobs, sp) -> bytes:
         if plan.kind == "pipeline":
@@ -1000,6 +1049,13 @@ class TepdistServicer:
         executable WorkerPlan (reference: BuildDistributedPlanRPC,
         virtual_client.cc:776)."""
         header, _ = protocol.unpack(request)
+        cached = self._idem_get(header)
+        if cached is not None:
+            # The original DispatchPlan was applied and its response lost:
+            # replaying it would discard the fresh RawStore (and any data
+            # already pushed into it) for nothing.
+            return cached
+        self._inject_server_fault("DispatchPlan")
         tasks = header.get("tasks", [])
         self._dispatched_tasks = tasks
         # Each plan gets a FRESH RawStore: an old plan's still-running
@@ -1022,7 +1078,8 @@ class TepdistServicer:
             # its recv waits would hang until timeout while new pushes land
             # in the fresh store above.
             self.worker_plan = None
-        return protocol.pack({"ok": True, "n_tasks": len(tasks)})
+        return self._idem_put(
+            header, protocol.pack({"ok": True, "n_tasks": len(tasks)}))
 
     def ExecuteRemotePlan(self, request: bytes, context=None) -> bytes:
         header, _ = protocol.unpack(request)
@@ -1148,7 +1205,16 @@ class TepdistServicer:
         """Cancel an in-flight ExecuteRemotePlan: wake every blocked recv
         wait with StepAbortedError. Sent by the master when a heartbeat
         declares a peer worker dead mid-step, so surviving workers return
-        at heartbeat latency instead of recv/RPC-timeout latency."""
+        at heartbeat latency instead of recv/RPC-timeout latency.
+
+        ``{"reset": true}`` instead CLEARS the abort flag (keeping the
+        store's data): the master's transient-fault step retry fences the
+        fleet with a plain AbortStep, then resets before re-executing the
+        same step from the already-received inputs."""
+        header, _ = protocol.unpack(request)
+        if header.get("reset"):
+            self.raw_store.reset_abort()
+            return protocol.pack({"ok": True, "reset": True})
         self.raw_store.abort()
         return protocol.pack({"ok": True})
 

@@ -120,6 +120,26 @@ class RawStore:
                       if k.endswith(suffix) or k.startswith(prefix)]:
                 del self._data[k]
 
+    @staticmethod
+    def _key_step(key: str) -> Optional[int]:
+        """The step index a store key belongs to: ``batch:{step}:{m}:{gi}``
+        or ``t{send_id}:{step}``; None for unrecognized keys."""
+        try:
+            if key.startswith("batch:"):
+                return int(key.split(":")[1])
+            return int(key.rsplit(":", 1)[1])
+        except (IndexError, ValueError):
+            return None
+
+    def clear_older(self, step: int) -> None:
+        """Drop every key from steps < ``step``. Abandoned-step leftovers
+        (kept for the master's transient-fault retry) are bounded by this:
+        once the fleet moves past a step, its data is gone."""
+        with self._cv:
+            for k in [k for k in self._data
+                      if (s := self._key_step(k)) is not None and s < step]:
+                del self._data[k]
+
     def clear(self) -> None:
         with self._cv:
             self._data.clear()
@@ -240,6 +260,20 @@ class WorkerPlan:
             max_workers=1, thread_name_prefix="ticket-send")
         self._send_futures: List[Any] = []
         self._peer_lock = threading.Lock()
+        # Idempotent step re-execution (transient-fault survival):
+        #  * _completed caches recent step results — a replayed
+        #    ExecuteRemotePlan (lost response / master step retry racing a
+        #    finished worker) returns the cached result instead of
+        #    re-applying updates.
+        #  * _staged_vars/_staged_opt hold this step's parameter/optimizer
+        #    writes until the step COMPLETES; commit is a batch of host
+        #    dict writes at step end (no RPC inside), so a failed or
+        #    abandoned step leaves the committed state exactly at the
+        #    previous step and a retry recomputes bit-identically.
+        self._completed: Dict[int, Dict[str, Any]] = {}
+        self._completed_max = 4
+        self._staged_vars: Dict[int, Any] = {}
+        self._staged_opt: Dict[int, List[Any]] = {}
 
     def _my_ip(self) -> str:
         return next((w["ip"] for w in self.meta["cluster"]["workers"]
@@ -289,9 +323,23 @@ class WorkerPlan:
 
     # ------------------------------------------------------------------
     def run_step(self, step: int) -> Dict[str, float]:
+        cached = self._completed.get(step)
+        if cached is not None:
+            # Replayed execution of an already-completed step (the
+            # response was lost, or the master's transient-fault retry
+            # reached a worker that had finished): the updates are already
+            # committed — re-running would double-apply them.
+            metrics().counter("dedup_hits").inc()
+            self.raw.clear_step(step)
+            return cached
         # Steps are master-serialized: starting step N means every peer
-        # pull of step < N has landed — free those parked buffers.
+        # pull of step < N has landed — free those parked buffers, and
+        # drop store keys left by earlier abandoned steps (kept then for
+        # the retry path; moot now).
         self.servicer.release_parked_transfers(before_step=step)
+        self.raw.clear_older(step)
+        self._staged_vars = {}
+        self._staged_opt = {}
         outputs: Dict[int, Tuple] = {}
         losses: List[float] = []
         ga_acc: Dict[int, Tuple] = {}
@@ -350,11 +398,15 @@ class WorkerPlan:
                     log.info("[task] %s#%d stage=%s %.3f ms", task["name"],
                              tid, s, sp.dur_ms)
             self._join_sends()
+            self._commit_staged()
             self.raw.clear_step(step)
             # ONE host round trip for all micro losses.
             out = {"losses": ([float(x) for x in
                                jax.device_get(jnp.stack(losses))]
                               if losses else [])}
+        self._completed[step] = out
+        while len(self._completed) > self._completed_max:
+            del self._completed[min(self._completed)]
         metrics().counter("worker_steps").inc()
         if debug:
             log.info("[run_step] worker=%d step=%d %.3f ms",
@@ -520,13 +572,30 @@ class WorkerPlan:
 
     def _abandon_step(self, step: int) -> None:
         """Failed-step cleanup before propagating: cancel queued ticket
-        notifications (stale plan_gen makes them moot) and drop the
-        step's store entries — cached DEVICE batch copies must not stay
-        pinned until the next DispatchPlan."""
+        notifications and discard the step's STAGED state writes (the
+        committed variables still hold the previous step — that is what
+        makes a retry of this step bit-identical). The step's store
+        entries are deliberately KEPT: a transient-fault retry re-executes
+        from the already-received batch slices/activations; if the fleet
+        instead moves on (escalation re-dispatches, or the next step
+        starts), DispatchPlan's fresh RawStore / run_step's clear_older
+        reclaims them."""
         for f in self._send_futures:
             f.cancel()
         self._send_futures.clear()
-        self.raw.clear_step(step)
+        self._staged_vars = {}
+        self._staged_opt = {}
+
+    def _commit_staged(self) -> None:
+        """Atomically (host dict writes under the GIL, no RPC) publish the
+        completed step's parameter/optimizer updates."""
+        for gi, p in self._staged_vars.items():
+            self.servicer.variables[gi] = p
+        if self._staged_opt:
+            self.opt_states = getattr(self, "opt_states", {})
+            self.opt_states.update(self._staged_opt)
+        self._staged_vars = {}
+        self._staged_opt = {}
 
     def _join_sends(self) -> None:
         """Surface async notification errors at step end (a failed send
@@ -589,18 +658,22 @@ class WorkerPlan:
 
         if not owned:
             return
+        # Reads see the COMMITTED (previous-step) state; writes stage until
+        # run_step completes (_commit_staged) — an abandoned/retried step
+        # never half-applies. Each stage's params are disjoint within a
+        # step, so staged entries never shadow a read.
         params_flat = [self.servicer.variables[gi] for gi in owned]
         if stage.opt_update is not None:
-            if s not in getattr(self, "opt_states", {}):
-                self.opt_states = getattr(self, "opt_states", {})
-                self.opt_states[s] = list(stage.opt_init(*params_flat))
-            state = tuple(self.opt_states[s])
+            cur = getattr(self, "opt_states", {}).get(s)
+            if cur is None:
+                cur = list(stage.opt_init(*params_flat))
+            state = tuple(cur)
         else:
             state = ()
         eaccs = [tuple(jnp.asarray(g) for g in extras[t]) for t in contrib]
         new_params, new_state = self._apply_jit[cache_key](
             tuple(params_flat), state, tuple(acc), *eaccs)
         if stage.opt_update is not None:
-            self.opt_states[s] = list(new_state)
+            self._staged_opt[s] = list(new_state)
         for gi, p in zip(owned, new_params):
-            self.servicer.variables[gi] = p
+            self._staged_vars[gi] = p

@@ -17,7 +17,7 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence
 
 from tepdist_tpu.core.cluster_spec import ClusterSpec
-from tepdist_tpu.rpc import protocol
+from tepdist_tpu.rpc import protocol, retry
 from tepdist_tpu.rpc.client import TepdistClient
 
 
@@ -84,27 +84,35 @@ class ExecutionCoordinator:
         for task_index, c in self.clients.items():
             tasks = [serialize_task(n) for n in dag.nodes
                      if n.worker_id == task_index]
-            c.stub.call("DispatchPlan", protocol.pack({
-                "tasks": tasks,
-                "split_nums": topology.split_nums,
-                "share_dev_flags": topology.share_dev_flags,
-                "placement_layout": topology.placement_layout,
-                "stage_split_ordinal": topology.stage_split_ordinal,
-            }))
+            try:
+                # client.call: per-verb deadline + retry + idem token.
+                c.call("DispatchPlan", {
+                    "tasks": tasks,
+                    "split_nums": topology.split_nums,
+                    "share_dev_flags": topology.share_dev_flags,
+                    "placement_layout": topology.placement_layout,
+                    "stage_split_ordinal": topology.stage_split_ordinal,
+                }, timeout=retry.deadline_for("DispatchPlan"))
+            except Exception as e:
+                raise RuntimeError(
+                    f"DispatchPlan failed on worker {task_index}: {e!r}"
+                ) from e
 
     def transfer_var_arg_map(self, var_arg_map: Dict[int, int]) -> None:
         for c in self.clients.values():
             c.transfer_var_arg_map(var_arg_map)
 
     def execute_remote_plan(self, handle: int = 0) -> List[dict]:
-        """One thread per worker (reference: ExecuteRemotePlan threads)."""
+        """One thread per worker (reference: ExecuteRemotePlan threads).
+        Each call runs under its verb's own deadline (not the blanket
+        default), and a failure names the worker that failed."""
         results: Dict[int, dict] = {}
         errors: Dict[int, Exception] = {}
 
         def run(ti: int, c: TepdistClient):
             try:
-                resp = c.stub.call("ExecuteRemotePlan",
-                                   protocol.pack({"handle": handle}))
+                resp = c.call("ExecuteRemotePlan", {"handle": handle},
+                              timeout=retry.deadline_for("ExecuteRemotePlan"))
                 results[ti], _ = protocol.unpack(resp)
             except Exception as e:  # noqa: BLE001
                 errors[ti] = e
@@ -116,7 +124,10 @@ class ExecutionCoordinator:
         for t in threads:
             t.join()
         if errors:
-            raise RuntimeError(f"remote plan failures: {errors}")
+            detail = "; ".join(
+                f"worker task_index={ti}: {e!r}"
+                for ti, e in sorted(errors.items()))
+            raise RuntimeError(f"remote plan failures: {detail}")
         return [results[ti] for ti in sorted(results)]
 
     def do_remote_save(self, max_to_keep: int, global_step: int) -> None:

@@ -10,6 +10,7 @@ p2p path becomes RPC raw-data pushes over DCN).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -26,6 +27,9 @@ from tepdist_tpu.runtime.coordinator import serialize_task
 from tepdist_tpu.runtime.execution_plan import build_pipeline_task_dag
 from tepdist_tpu.runtime.task_graph import TaskType
 from tepdist_tpu.runtime.task_scheduler import TaskScheduler
+from tepdist_tpu.telemetry import metrics
+
+log = logging.getLogger(__name__)
 
 
 class DistributedPipelineSession:
@@ -182,9 +186,9 @@ class DistributedPipelineSession:
                         jax.tree_util.tree_leaves(state_shape))
                     blobs.append(serialize_closed_jaxpr(init_closed))
                     blobs.append(serialize_closed_jaxpr(update_closed))
-            self.clients[self.stage_worker[s]].stub.call(
+            self.clients[self.stage_worker[s]].call(
                 "TransferModuleAndDefCtx",
-                protocol.pack({"module_id": s, "stage_meta": meta}, blobs))
+                {"module_id": s, "stage_meta": meta}, blobs)
 
         # Dispatch per-worker plans in global schedule order, with the GC
         # plan computed for that order (workers prune via mem_to_release).
@@ -221,12 +225,17 @@ class DistributedPipelineSession:
                 "recv_keys": recv_keys,
                 "learning_rate": learning_rate,
             }
-            self.clients[ti].stub.call("DispatchPlan", protocol.pack({
+            # client.call attaches the idempotency token: a retried
+            # DispatchPlan whose original landed (response lost) must not
+            # re-run — it would discard the fresh RawStore and any data
+            # already pushed into it.
+            self.clients[ti].call("DispatchPlan", {
                 "tasks": [serialize_task(n) for n in tasks],
                 "plan_meta": plan_meta,
                 "plan_gen": self._plan_gen,
-            }))
+            })
         self._step = 0
+        self._step_attempts = 0
         # Heartbeat monitor (surplus over the reference, which had no
         # failure detection at all — SURVEY §5.3).
         from tepdist_tpu.runtime.health import HealthMonitor
@@ -313,42 +322,25 @@ class DistributedPipelineSession:
                             {"raw_key": f"batch:{step}:{m}:{gi}",
                              "literal": meta})
                         blobs.append(blob)
-                    self.clients[ti].stub.call(
-                        "TransferHostRawData", protocol.pack(
-                            {"raw_multi": entries,
-                             "plan_gen": self._plan_gen}, blobs))
+                    self.clients[ti].call(
+                        "TransferHostRawData",
+                        {"raw_multi": entries,
+                         "plan_gen": self._plan_gen}, blobs)
                 except Exception as e:  # noqa: BLE001
                     push_errors[ti] = e
                     break
         if push_errors:
-            # Same healthy-vs-dead split as the execute path below: a push
-            # can fail transiently (e.g. a slow restart) without the
-            # worker being gone.
-            status = self.health.check_once()
-            self.health.dead |= {ti for ti in push_errors
-                                 if not status.get(ti, False)}
-            if self._elastic:
-                attempts = getattr(self, "_redispatch_attempts", 0)
-                if attempts >= self.cluster.num_workers:
-                    raise RuntimeError(
-                        f"elastic re-dispatch gave up after {attempts} "
-                        f"attempts; worker failures: {push_errors}")
-                self._auto_redispatch()
-                self._redispatch_attempts = attempts + 1
-                return self.step(*batch)
-            raise RuntimeError(
-                f"worker failures: {push_errors}; "
-                f"dead={sorted(self.health.dead)} — restore the cluster "
-                "and resume from checkpoint")
+            # Same transient/permanent ladder as the execute path below: a
+            # push can fail transiently without the worker being gone, and
+            # re-pushing the same keys is idempotent.
+            return self._recover_step(push_errors, batch)
         # Run every worker's plan concurrently.
         results: Dict[int, dict] = {}
         errors: Dict[int, Exception] = {}
 
         def run(ti, client):
             try:
-                resp = client.stub.call(
-                    "ExecuteRemotePlan",
-                    protocol.pack({"step": step}), timeout=300.0)
+                resp = client.call("ExecuteRemotePlan", {"step": step})
                 results[ti], _ = protocol.unpack(resp)
             except Exception as e:  # noqa: BLE001
                 errors[ti] = e
@@ -362,34 +354,96 @@ class DistributedPipelineSession:
         # join) may write into `errors` while we iterate it below.
         errors = dict(errors)
         if errors:
-            # Distinguish dead workers from survivors whose step merely
-            # failed/aborted (e.g. StepAbortedError after a peer died):
-            # only workers whose ping ALSO fails right now are declared
-            # dead — a healthy worker that errored must stay in the
-            # cluster or elastic re-dispatch would evict the survivors it
-            # is about to rebuild onto.
-            status = self.health.check_once()
-            self.health.dead |= {ti for ti in errors
-                                 if not status.get(ti, False)}
-            if self._elastic:
-                attempts = getattr(self, "_redispatch_attempts", 0)
-                if attempts >= self.cluster.num_workers:
-                    raise RuntimeError(
-                        f"elastic re-dispatch gave up after {attempts} "
-                        f"attempts; worker failures: {errors}")
-                self._auto_redispatch()
-                self._redispatch_attempts = attempts + 1
-                return self.step(*batch)   # retry on the new plan
-            raise RuntimeError(
-                f"worker failures: {errors}; dead={sorted(self.health.dead)}"
-                " — restore the cluster and resume from checkpoint")
+            return self._recover_step(errors, batch, threads=threads)
         self._step += 1
         self._redispatch_attempts = 0   # a full step succeeded: reset cap
+        self._step_attempts = 0
         losses = results[self.loss_worker].get("losses", [])
         if (self._elastic and self._autosave_every > 0
                 and self._step % self._autosave_every == 0):
             self.save()
         return float(sum(losses) / max(len(losses), 1))
+
+    # ------------------------------------------------------------------
+    # Transient-vs-permanent recovery ladder (ISSUE pr3): a mid-step fault
+    # whose workers all still answer Ping is TRANSIENT — fence the fleet,
+    # clear the abort latch, and re-execute the SAME step from in-memory
+    # variables (worker-side staged commits + completed-step caches make
+    # the re-run bit-identical, zero checkpoint rollback). Only a
+    # heartbeat-dead worker escalates to elastic re-dispatch / raise.
+    max_step_retries: int = 3
+
+    def _recover_step(self, errs: Dict[int, Exception], batch,
+                      threads=()) -> float:
+        from tepdist_tpu.rpc import retry as _retry
+
+        status = self.health.check_once()
+        newly_dead = {ti for ti in errs if not status.get(ti, False)}
+        self.health.dead |= newly_dead
+        # A straggler thread still alive here means some ExecuteRemotePlan
+        # may STILL be running server-side; likewise a deadline-exceeded
+        # execute on a ping-alive worker. Re-executing concurrently with
+        # the original would double-run the step, so neither qualifies as
+        # a safe transient retry.
+        stragglers = any(t.is_alive() for t in threads)
+        deadline_errs = any(_retry._is_deadline_exc(e)
+                            for e in errs.values())
+        if not newly_dead and not stragglers and not deadline_errs:
+            if self._step_attempts < self.max_step_retries:
+                self._step_attempts += 1
+                metrics().counter("step_retries").inc()
+                log.warning(
+                    "step %d fault looks transient (all pings ok); fencing "
+                    "fleet and re-executing same step from in-memory state "
+                    "(attempt %d/%d): %s", self._step, self._step_attempts,
+                    self.max_step_retries,
+                    {ti: repr(e) for ti, e in errs.items()})
+                self._reset_fleet_step()
+                return self.step(*batch)
+            raise RuntimeError(
+                f"step {self._step} still failing after "
+                f"{self._step_attempts} transient retries: {errs}")
+        if self._elastic:
+            attempts = getattr(self, "_redispatch_attempts", 0)
+            if attempts >= self.cluster.num_workers:
+                raise RuntimeError(
+                    f"elastic re-dispatch gave up after {attempts} "
+                    f"attempts; worker failures: {errs}")
+            self._auto_redispatch()
+            self._redispatch_attempts = attempts + 1
+            return self.step(*batch)   # retry on the new plan
+        raise RuntimeError(
+            f"worker failures: {errs}; dead={sorted(self.health.dead)}"
+            " — restore the cluster and resume from checkpoint")
+
+    def _fence_fleet(self) -> None:
+        """AbortStep every live worker: wakes recv waits blocked on data a
+        failed peer will never send, so their ExecuteRemotePlan RPCs
+        return now instead of at recv-timeout."""
+        for ti, client in self.clients.items():
+            if ti in self.health.dead:
+                continue
+            try:
+                client.call("AbortStep", {}, timeout=self.health.timeout,
+                            max_attempts=2)
+            except Exception:  # noqa: BLE001 — dying too; classified later
+                pass
+
+    def _reset_fleet_step(self) -> None:
+        """Fence then clear: AbortStep latches the abort flag (waking any
+        remaining blocked recv), then ``reset`` clears it WITHOUT dropping
+        the raw store's data — the retry re-executes from already-received
+        inputs, and workers that finished the step serve their cached
+        result instead of re-running."""
+        for ti, client in self.clients.items():
+            if ti in self.health.dead:
+                continue
+            for hdr in ({}, {"reset": True}):
+                try:
+                    client.call("AbortStep", hdr,
+                                timeout=self.health.timeout, max_attempts=2)
+                except Exception:  # noqa: BLE001 — best-effort; the retry
+                    pass           # itself surfaces anything still broken
 
     # ------------------------------------------------------------------
     abort_grace_s: float = 10.0   # how long to wait for aborted RPCs
@@ -406,13 +460,25 @@ class DistributedPipelineSession:
         detection at all (SURVEY §5.3)."""
         if grace_s is None:
             grace_s = self.abort_grace_s
-        poll = max(self.health.interval, 0.5)
+        # Cap the poll so a worker ERROR (not just a death) fences peers at
+        # ~poll latency rather than recv-timeout latency; Pings are cheap.
+        poll = max(min(self.health.interval, 2.0), 0.25)
         while True:
             alive = [t for t in threads if t.is_alive()]
             if not alive:
                 return
             alive[0].join(timeout=poll)
             if any(t.is_alive() for t in threads):
+                if errors:
+                    # Some worker already failed while peers still run:
+                    # their recvs may block on data the failed worker will
+                    # never send. Fence NOW; _recover_step classifies the
+                    # fault as transient (retry) or permanent (elastic).
+                    self._fence_fleet()
+                    deadline = time.time() + grace_s
+                    for t in threads:
+                        t.join(timeout=max(0.0, deadline - time.time()))
+                    return
                 before = set(self.health.dead)
                 self.health.check_once()
                 newly_dead = self.health.dead - before
@@ -421,14 +487,7 @@ class DistributedPipelineSession:
                         errors.setdefault(ti, RuntimeError(
                             "worker died mid-step (heartbeat)"))
                     # Wake survivors' recv waits so their RPCs return now.
-                    for ti, client in self.clients.items():
-                        if ti in self.health.dead:
-                            continue
-                        try:
-                            client.stub.call("AbortStep", protocol.pack({}),
-                                             timeout=self.health.timeout)
-                        except Exception:  # noqa: BLE001 - dying too
-                            pass
+                    self._fence_fleet()
                     deadline = time.time() + grace_s
                     for t in threads:
                         t.join(timeout=max(0.0, deadline - time.time()))
@@ -442,9 +501,7 @@ class DistributedPipelineSession:
         surviving workers adopt the dead workers' stages; variable
         placement is re-derived from the parameter template; each survivor
         restores the UNION of all workers' checkpoint shards."""
-        import logging
-        log = logging.getLogger(__name__)
-
+        metrics().counter("elastic_redispatch").inc()
         dead = set(self.health.dead)
         survivors = [w for w in self.cluster.workers
                      if w.task_index not in dead]
@@ -479,6 +536,7 @@ class DistributedPipelineSession:
         lost = self._step - max(restored, 0)
         self._step = restored if restored >= 0 else 0
         if lost > 0:
+            metrics().counter("checkpoint_rollback_steps").inc(lost)
             log.warning(
                 "elastic re-dispatch ROLLED BACK %d step(s) to the last "
                 "checkpoint (step %d): updates since then are discarded "

@@ -40,13 +40,27 @@ class HealthMonitor:
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
+    def revive(self, ti: int) -> None:
+        """Clear a worker's dead mark + miss count (its process came back
+        or the partition healed). The next sweep treats it as healthy."""
+        if ti in self.dead:
+            self.dead.discard(ti)
+            self.misses[ti] = 0
+            from tepdist_tpu.telemetry import metrics
+            metrics().counter("worker_revived").inc()
+            log.warning("worker %d revived (heartbeat answered again)", ti)
+
     def check_once(self) -> Dict[int, bool]:
-        """One synchronous sweep; returns {task_index: healthy}."""
+        """One synchronous sweep; returns {task_index: healthy}.
+
+        Dead workers are RE-PROBED each sweep: a successful Ping revives
+        them (clears dead + misses) instead of leaving a recovered process
+        marked dead forever. Snapshot the client map so a concurrent
+        re-dispatch swapping ``self.clients`` mid-sweep cannot blow up the
+        iteration."""
         status: Dict[int, bool] = {}
-        for ti, client in self.clients.items():
-            if ti in self.dead:
-                status[ti] = False
-                continue
+        for ti, client in list(self.clients.items()):
+            was_dead = ti in self.dead
             try:
                 from tepdist_tpu.rpc import protocol
                 from tepdist_tpu.telemetry import metrics
@@ -57,6 +71,8 @@ class HealthMonitor:
                 header, _ = protocol.unpack(resp)
                 ok = bool(header.get("ok"))
                 if ok:
+                    if was_dead:
+                        self.revive(ti)
                     self.misses[ti] = 0
                     self.last_seen[ti] = time.time()
                     self.last_rtt_ms[ti] = rtt_ms
@@ -65,8 +81,10 @@ class HealthMonitor:
                     m.histogram("heartbeat_rtt_ms").observe(rtt_ms)
                 status[ti] = ok
             except Exception as e:  # noqa: BLE001
-                self.misses[ti] = self.misses.get(ti, 0) + 1
                 status[ti] = False
+                if was_dead:
+                    continue   # still dead; on_failure already fired once
+                self.misses[ti] = self.misses.get(ti, 0) + 1
                 if self.misses[ti] >= self.max_misses:
                     self.dead.add(ti)
                     log.error("worker %d declared dead after %d missed "
@@ -95,6 +113,13 @@ class HealthMonitor:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=self.interval + 1)
+            if self._thread.is_alive():
+                # Keep the reference: dropping it would leak a running
+                # thread we could never join; a later stop() retries.
+                log.warning("heartbeat thread did not stop within %.1fs; "
+                            "keeping reference for a later join",
+                            self.interval + 1)
+                return
             self._thread = None
 
     def healthy(self) -> bool:

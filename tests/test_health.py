@@ -52,7 +52,8 @@ def test_all_healthy_resets_misses_and_records_rtt():
 
 def test_misses_accumulate_then_dead_then_raise():
     failures = []
-    clients = {0: _FakeClient(), 1: _FakeClient(["raise", "raise", "raise"])}
+    clients = {0: _FakeClient(),
+               1: _FakeClient(["raise", "raise", "raise"])}
     mon = HealthMonitor(clients, max_misses=2,
                         on_failure=lambda ti, e: failures.append((ti, e)))
     assert mon.check_once() == {0: True, 1: False}
@@ -61,12 +62,52 @@ def test_misses_accumulate_then_dead_then_raise():
     assert 1 in mon.dead
     assert [ti for ti, _ in failures] == [1]
     assert isinstance(failures[0][1], ConnectionError)
-    # Once dead, the worker is not pinged again (2 failing calls, not 3).
+    # Dead workers ARE re-probed each sweep (3rd failing call) but stay
+    # dead while the probe fails — and on_failure does not fire again.
     mon.check_once()
-    assert clients[1].stub.calls == 2
+    assert clients[1].stub.calls == 3
+    assert 1 in mon.dead and [ti for ti, _ in failures] == [1]
     assert not mon.healthy()
     with pytest.raises(RuntimeError, match=r"workers \[1\] are dead"):
         mon.assert_healthy()
+
+
+def test_dead_worker_revived_by_successful_reprobe():
+    metrics().reset()
+    # Two failing sweeps kill worker 0; the script then answers again.
+    mon = HealthMonitor({0: _FakeClient(["raise", "raise", "ok"])},
+                        max_misses=2)
+    mon.check_once()
+    mon.check_once()
+    assert 0 in mon.dead
+    status = mon.check_once()   # re-probe succeeds -> automatic revive
+    assert status == {0: True}
+    assert not mon.dead and mon.misses[0] == 0 and mon.healthy()
+    assert metrics().snapshot()["counters"]["worker_revived"] == 1
+
+
+def test_revive_clears_dead_and_misses():
+    mon = HealthMonitor({0: _FakeClient(["raise"])}, max_misses=1)
+    mon.check_once()
+    assert 0 in mon.dead
+    mon.revive(0)
+    assert not mon.dead and mon.misses[0] == 0
+    mon.revive(0)   # idempotent on an already-live worker
+    assert mon.healthy()
+
+
+def test_check_once_snapshots_clients_mid_sweep():
+    # A concurrent re-dispatch may swap self.clients while a sweep is
+    # iterating; the sweep must work over its own snapshot.
+    class _SwappingDict(dict):
+        def items(self):
+            snap = list(super().items())
+            self.clear()   # simulate the swap happening mid-iteration
+            return iter(snap)
+
+    clients = _SwappingDict({0: _FakeClient(), 1: _FakeClient()})
+    mon = HealthMonitor(clients, max_misses=2)
+    assert mon.check_once() == {0: True, 1: True}
 
 
 def test_not_ok_response_counts_as_unhealthy_but_not_a_miss():

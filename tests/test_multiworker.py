@@ -593,10 +593,10 @@ def test_mid_step_worker_death_detected_by_heartbeat(tmp_path, victim_ti):
         victim = sess.clients[victim_ti].stub
         orig_call = victim.call
 
-        def stopping_call(method, payload, timeout=300.0):
+        def stopping_call(method, payload, timeout=None, **kw):
             if method == "ExecuteRemotePlan":
                 victim_proc.send_signal(signal.SIGSTOP)
-            return orig_call(method, payload, timeout=timeout)
+            return orig_call(method, payload, timeout=timeout, **kw)
 
         victim.call = stopping_call
         t0 = _time.monotonic()
@@ -818,10 +818,10 @@ def test_mid_step_death_at_four_workers(tmp_path):
         victim = sess.clients[2].stub
         orig_call = victim.call
 
-        def stopping_call(method, payload, timeout=300.0):
+        def stopping_call(method, payload, timeout=None, **kw):
             if method == "ExecuteRemotePlan":
                 victim_proc.send_signal(signal.SIGSTOP)
-            return orig_call(method, payload, timeout=timeout)
+            return orig_call(method, payload, timeout=timeout, **kw)
 
         victim.call = stopping_call
         t0 = _time.monotonic()

@@ -122,9 +122,19 @@ def main() -> None:
         print(f"  {w['label']:<10} (pid {pid}) window={w['window_ms']:.1f} "
               f"ms busy={w['busy_fraction']:.1%}{bubble}")
     counters = (s.get("metrics") or {}).get("counters") or {}
-    if counters:
+    fault = {k: v for k, v in counters.items()
+             if k.split(":")[0] in (
+                 "fault_injected", "rpc_retries", "step_retries",
+                 "dedup_hits", "worker_revived", "elastic_redispatch",
+                 "checkpoint_rollback_steps")}
+    if fault:
+        print("fault recovery:")
+        for k, v in sorted(fault.items()):
+            print(f"  {k:<28} {v}")
+    rest = {k: v for k, v in counters.items() if k not in fault}
+    if rest:
         print("counters:")
-        for k, v in sorted(counters.items()):
+        for k, v in sorted(rest.items()):
             print(f"  {k:<28} {v}")
 
 
