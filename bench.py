@@ -614,6 +614,52 @@ def bench_trace_overhead() -> dict:
     }
 
 
+def bench_serving(n_requests: int = 16, rounds: int = 3) -> dict:
+    """Continuous-batching serving throughput (tepdist_tpu/serving/):
+    one engine, mixed prompt/output lengths, decode tokens/s with the
+    scheduler + slot pool + length-bucketed executables on the path.
+    One warmup round absorbs the prefill/decode compiles; the median of
+    the measured rounds is reported under the spread guard like every
+    other line."""
+    import numpy as np
+
+    from tepdist_tpu.models import gpt2
+    from tepdist_tpu.serving import ServingEngine
+
+    cfg = gpt2.CONFIGS["test"]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(params, cfg, slots=4, max_len=32,
+                        max_queue=n_requests + 1, name="bench")
+    rng = np.random.RandomState(0)
+
+    def one_round(tag: str) -> float:
+        toks = 0
+        for i in range(n_requests):
+            t = int(rng.randint(3, 13))
+            m = int(rng.randint(2, 8))
+            eng.submit(f"{tag}-{i}",
+                       rng.randint(0, cfg.vocab_size,
+                                   size=t).astype(np.int32),
+                       max_new_tokens=m)
+            toks += m
+        t0 = time.perf_counter()
+        eng.run_until_idle()
+        return toks / (time.perf_counter() - t0)
+
+    one_round("warm")
+    vals = sorted(one_round(f"r{k}") for k in range(rounds))
+    med = vals[len(vals) // 2]
+    spread = (vals[-1] - vals[0]) / med if med else 0.0
+    return {
+        "metric": "serving_tok_s",
+        "value": round(med, 1),
+        "unit": "tokens/s",
+        "n_requests": n_requests,
+        "slots": 4,
+        **_verdict_fields("serving_tok_s", med, spread),
+    }
+
+
 def _persist_tpu_headline(line: dict) -> None:
     """Record the last-good TPU headline with provenance so a future
     tunnel wedge degrades to a STALE-FLAGGED TPU number, never a CPU
@@ -725,6 +771,11 @@ def main() -> None:
         except Exception:
             extra.append({"metric": "trace_overhead", "error":
                           traceback.format_exc(limit=3).splitlines()[-1]})
+        try:
+            extra.append(bench_serving())
+        except Exception:
+            extra.append({"metric": "serving_tok_s", "error":
+                          traceback.format_exc(limit=3).splitlines()[-1]})
         # Carry forward the last TPU round's secondary lines STALE-FLAGGED
         # (mirroring the headline policy) instead of silently dropping
         # them: the fresh runtime line replaces only its own metric.
@@ -788,6 +839,7 @@ def main() -> None:
             pass
     selected = {
         "trace": bench_trace_overhead,   # ~ms; telemetry no-op guarantee
+        "serving": bench_serving,        # continuous-batching decode tok/s
         "117m": lambda: bench_gpt2_117m(True),
         "runtime": bench_runtime_protocol,   # pinned protocol, every round
         "flash": bench_flash_attention_long,

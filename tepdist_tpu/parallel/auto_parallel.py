@@ -457,6 +457,7 @@ def auto_parallel_explore(
         pipeline_candidates,
         seq_candidates,
         spmd_candidates,
+        winner_lowering_postcheck,
     )
     from tepdist_tpu.parallel.spmd_transform import SpmdTransform as _Xform
 
@@ -501,6 +502,11 @@ def auto_parallel_explore(
         log.info("exploration winner: %s (duration %.3e s/step) of %d "
                  "proposals", best["kind"], best["cost"].total_duration,
                  len(candidates))
+        if not isinstance(plan, PipelineWinner):
+            # Winner-only lowering post-check (NOTES_NEXT gap #2): pipeline
+            # winners have no single lowered jit to diagnose until
+            # .build(); SPMD/seq winners compile here anyway.
+            winner_lowering_postcheck(plan, devices=devices)
         return plan
     raise RuntimeError("no proposal could be materialized")
 

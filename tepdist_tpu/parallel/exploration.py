@@ -352,6 +352,42 @@ def explore(
     return best
 
 
+def winner_lowering_postcheck(plan, devices=None) -> List[str]:
+    """Winner-only lowering post-check (NOTES_NEXT gap #2) for the
+    LIBRARY explore path: the search loop cannot afford a compile per
+    candidate, but the chosen plan compiles anyway —
+    ``lowering_diagnostics`` reuses the plan's own state-donating jit, so
+    the diagnostic compile is cached and the first real step pays nothing
+    extra. Any 'involuntary full rematerialization' hits are recorded on
+    the plan (``plan.lowering_remats``), folded into the winner's
+    candidate row (so ``candidate_summary`` surfaces them), and counted
+    under the ``involuntary_remat`` warning counter — the same consumer
+    contract as the service/train paths. Gated by LOWERING_POSTCHECK."""
+    if not ServiceEnv.get().lowering_postcheck:
+        return []
+    from tepdist_tpu.telemetry import metrics
+
+    try:
+        remats = plan.lowering_diagnostics(devices=devices)
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        log.warning("lowering post-check failed: %r", e)
+        return []
+    plan.lowering_remats = list(remats)
+    for c in getattr(plan, "candidates", None) or ():
+        # The winner's candidate dict shares its Cost object with the plan.
+        if c.get("cost") is getattr(plan, "cost", None):
+            c["involuntary_remats"] = list(remats)
+    if remats:
+        metrics().counter("involuntary_remat").inc(len(remats))
+        log.warning(
+            "explore winner (axes=%s): XLA reported %d involuntary full "
+            "rematerialization(s) (%s) — the chosen sharding forces "
+            "recompute the cost model did not price; consider a different "
+            "topology", list(plan.topology.device_axes()), len(remats),
+            ", ".join(remats[:3]))
+    return list(remats)
+
+
 def candidate_summary(candidates, best=None) -> List[Dict[str, Any]]:
     """Wire/debug-friendly ranked table of explored candidates (reference:
     candidate strategy dumps, auto_parallel.cc:309-311)."""
@@ -372,6 +408,8 @@ def candidate_summary(candidates, best=None) -> List[Dict[str, Any]]:
             "memory_feasible": bool(cost.memory_feasible),
             "winner": best is not None and c is best,
         })
+        if "involuntary_remats" in c:
+            rows[-1]["involuntary_remats"] = len(c["involuntary_remats"])
     return rows
 
 

@@ -35,7 +35,12 @@ from tepdist_tpu.telemetry import metrics, span
 # else is naturally idempotent (pure reads, or keyed puts that overwrite
 # with the same value).
 IDEMPOTENT_TOKEN_VERBS = {"ExecutePlan", "DispatchPlan",
-                          "TransferToServerHost"}
+                          "TransferToServerHost",
+                          # Serving verbs: a replayed LoadServable must not
+                          # build a second engine, a replayed SubmitRequest
+                          # must not generate twice, a replayed Cancel must
+                          # report the original cancel's outcome.
+                          "LoadServable", "SubmitRequest", "CancelRequest"}
 
 
 class GRPCStub:
@@ -260,6 +265,67 @@ class TepdistClient:
         header, blobs = protocol.unpack(resp)
         return {int(m["global_idx"]): protocol.decode_literal(m, blobs[i])
                 for i, m in enumerate(header["vars"])}
+
+    # -- serving ----------------------------------------------------
+    def load_servable(self, config: Dict[str, Any],
+                      param_leaves: Sequence[np.ndarray], *,
+                      slots: int = 4, max_len: Optional[int] = None,
+                      buckets: Optional[Sequence[int]] = None,
+                      max_queue: int = 64,
+                      name: str = "servable") -> str:
+        """Ship a model (JSON-able GPT2Config dict + flat param leaves in
+        tree_flatten order) and start its serving engine. Returns the
+        servable id used by the other serve verbs."""
+        metas, blobs = [], []
+        for leaf in param_leaves:
+            meta, blob = protocol.encode_literal(np.asarray(leaf))
+            metas.append(meta)
+            blobs.append(blob)
+        resp = self.call("LoadServable", {
+            "config": config, "params_meta": metas, "slots": int(slots),
+            "max_len": max_len,
+            "buckets": list(buckets) if buckets is not None else None,
+            "max_queue": int(max_queue), "name": name}, blobs)
+        header, _ = protocol.unpack(resp)
+        return header["servable_id"]
+
+    def submit_request(self, servable_id: str, request_id: str,
+                       prompt, *, max_new_tokens: int, greedy: bool = True,
+                       temperature: float = 1.0, top_k: int = 0,
+                       seed: int = 0,
+                       deadline_ms: Optional[float] = None
+                       ) -> Dict[str, Any]:
+        meta, blob = protocol.encode_literal(
+            np.asarray(prompt, np.int32).reshape(-1))
+        resp = self.call("SubmitRequest", {
+            "servable_id": servable_id, "request_id": request_id,
+            "prompt": meta, "max_new_tokens": int(max_new_tokens),
+            "greedy": bool(greedy), "temperature": float(temperature),
+            "top_k": int(top_k), "seed": int(seed),
+            "deadline_ms": deadline_ms}, [blob])
+        header, _ = protocol.unpack(resp)
+        return header
+
+    def poll_result(self, servable_id: str,
+                    request_ids: Optional[Sequence[str]] = None,
+                    wait_ms: float = 0.0) -> List[Dict[str, Any]]:
+        """Long-poll request states; generated tokens ride in the JSON
+        header (they are short int lists, not tensor payloads)."""
+        resp = self.call("PollResult", {
+            "servable_id": servable_id,
+            "request_ids": (list(request_ids)
+                            if request_ids is not None else None),
+            "wait_ms": float(wait_ms)},
+            timeout=retry.deadline_for("PollResult") + wait_ms / 1e3)
+        header, _ = protocol.unpack(resp)
+        return header["results"]
+
+    def cancel_request(self, servable_id: str,
+                       request_id: str) -> bool:
+        resp = self.call("CancelRequest", {
+            "servable_id": servable_id, "request_id": request_id})
+        header, _ = protocol.unpack(resp)
+        return bool(header["cancelled"])
 
     # -- checkpoint ----------------------------------------------------
     def do_remote_save(self, max_to_keep: int = 5,

@@ -32,13 +32,24 @@ RPC surface (method -> reference RPC):
                            ring buffer + metrics snapshot, stamped with the
                            worker's clock so the client can align fleets'
                            timelines — telemetry/export.py)
+  LoadServable          -> (no reference analogue: ships a model config +
+                           params and starts a continuous-batching serving
+                           engine — tepdist_tpu/serving/)
+  SubmitRequest         -> (serving: enqueue one generation request under
+                           admission control; replays dedup via idem token)
+  PollResult            -> (serving: long-poll request states/tokens —
+                           a pure read, naturally idempotent)
+  CancelRequest         -> (serving: cancel a queued/active request)
 
 Retry + idempotency (rpc/retry.py, no reference analogue): mutating verbs
-(ExecutePlan, DispatchPlan, TransferToServerHost) carry an ``idem`` header
-token — ``"<client-uid>:<method>:<seq>"`` — and the server caches each
+(ExecutePlan, DispatchPlan, TransferToServerHost, LoadServable,
+SubmitRequest, CancelRequest) carry an ``idem`` header token —
+``"<client-uid>:<method>:<seq>"`` — and the server caches each
 token's response bytes, so a retried request whose original WAS applied
 (response lost in flight) is answered from the cache instead of being
-re-run. All other verbs are naturally idempotent (pure reads or keyed puts
+re-run. SubmitRequest is additionally deduped by request id inside the
+engine, so even a replay past the LRU idem cache cannot generate twice.
+All other verbs are naturally idempotent (pure reads or keyed puts
 that overwrite with identical values).
 """
 
@@ -68,6 +79,10 @@ METHODS = [
     "AbortStep",
     "Ping",
     "GetTelemetry",
+    "LoadServable",
+    "SubmitRequest",
+    "PollResult",
+    "CancelRequest",
 ]
 
 # Reference keeps INT_MAX message sizes (client_library.cc:152-156).

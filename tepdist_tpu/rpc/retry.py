@@ -57,6 +57,12 @@ DEADLINES = {
     "ExecutePlan": 600.0,
     "ExecuteRemotePlan": 600.0,
     "BuildExecutionPlan": 900.0,
+    # Serving: LoadServable ships params + warms compiles; PollResult's
+    # budget is on top of the client-requested long-poll wait.
+    "LoadServable": 300.0,
+    "SubmitRequest": 30.0,
+    "PollResult": 60.0,
+    "CancelRequest": 15.0,
 }
 DEFAULT_DEADLINE = 300.0
 

@@ -209,8 +209,13 @@ class TepdistServicer:
         # the remote pull completes. The task-list GC only tracks LOCAL
         # consumers, so without this the transfer server serves deleted
         # buffers. Freed one step behind (the master serializes steps, so
-        # when this worker starts step N every step N-1 pull has landed).
+        # when this worker starts step N every step N-1 pull has landed),
+        # or immediately at AbortStep (the abort latch fails any pull
+        # ticket issued before the abort, so no holder can still land).
         self._parked_transfers: Dict[int, List[Any]] = {}
+        # Serving engines (tepdist_tpu/serving/): servable_id -> engine.
+        self.servables: Dict[str, Any] = {}
+        self._servable_next = 1
 
     # -- idempotency dedup (see _idem_cache in __init__) ----------------
     _IDEM_CACHE_MAX = 128
@@ -246,7 +251,7 @@ class TepdistServicer:
         metrics().counter("transfers_parked").inc()
 
     def release_parked_transfers(self, before_step: Optional[int] = None
-                                 ) -> None:
+                                 ) -> int:
         with self._lock:
             gone = [s for s in self._parked_transfers
                     if before_step is None or s < before_step]
@@ -255,10 +260,8 @@ class TepdistServicer:
                 freed += len(self._parked_transfers[s])
                 del self._parked_transfers[s]
         if freed:
-            # NOTES_NEXT gap #5: parked != freed at shutdown is the
-            # bounded abort-path leak — now a visible counter delta
-            # instead of folklore.
             metrics().counter("transfers_freed").inc(freed)
+        return freed
 
     def _sync_active_pipeline(self) -> None:
         """Flush the live pipeline runtime's state into the variable store
@@ -1216,7 +1219,17 @@ class TepdistServicer:
             self.raw_store.reset_abort()
             return protocol.pack({"ok": True, "reset": True})
         self.raw_store.abort()
-        return protocol.pack({"ok": True})
+        # Free parked transfer buffers NOW rather than lazily on the next
+        # DispatchPlan: the abort latch already fails every pre-abort pull
+        # ticket with a clean StepAbortedError (worker_plan.py), so no
+        # ticket holder can land a pull against a freed buffer — holding
+        # the device memory across the whole recovery window was a pure
+        # leak. A subsequent same-step retry re-runs the producer sends,
+        # re-parking fresh buffers under fresh tickets.
+        freed = self.release_parked_transfers()
+        if freed:
+            metrics().counter("transfers_freed_on_abort").inc(freed)
+        return protocol.pack({"ok": True, "freed_transfers": freed})
 
     def Ping(self, request: bytes, context=None) -> bytes:
         return protocol.pack({
@@ -1244,6 +1257,101 @@ class TepdistServicer:
             "spans": spans,
             "metrics": telemetry.metrics().snapshot(),
         })
+
+    # -- serving verbs (tepdist_tpu/serving/) ---------------------------
+    def _servable(self, sid: str):
+        eng = self.servables.get(sid)
+        if eng is None:
+            raise ValueError(f"unknown servable {sid!r} "
+                             f"(loaded: {sorted(self.servables)})")
+        return eng
+
+    def LoadServable(self, request: bytes, context=None) -> bytes:
+        """Ship a model (config spec + flat param leaves in tree_flatten
+        order) and start its continuous-batching engine. Idempotent: a
+        replayed load answers with the original servable id instead of
+        building a second engine."""
+        header, blobs = protocol.unpack(request)
+        cached = self._idem_get(header)
+        if cached is not None:
+            return cached
+        self._inject_server_fault("LoadServable")
+        from tepdist_tpu.models import gpt2
+        from tepdist_tpu.serving.engine import ServingEngine
+        from tepdist_tpu.serving.kv_cache import config_from_spec
+
+        cfg = config_from_spec(header["config"])
+        leaves = [protocol.decode_literal(m, blobs[i])
+                  for i, m in enumerate(header["params_meta"])]
+        sds = jax.eval_shape(
+            lambda: gpt2.init_params(cfg, jax.random.PRNGKey(0)))
+        tree = jax.tree_util.tree_structure(sds)
+        params = jax.tree_util.tree_unflatten(tree, leaves)
+        with self._lock:
+            sid = f"sv{self._servable_next}"
+            self._servable_next += 1
+        name = header.get("name") or sid
+        eng = ServingEngine(
+            params, cfg, slots=int(header.get("slots", 4)),
+            max_len=header.get("max_len"),
+            buckets=header.get("buckets"),
+            max_queue=int(header.get("max_queue", 64)),
+            name=f"{name}@{self.task_index}")
+        eng.start()
+        self.servables[sid] = eng
+        log.info("LoadServable %s: %s", sid, eng.stats())
+        return self._idem_put(header, protocol.pack(
+            {"ok": True, "servable_id": sid, **eng.stats()}))
+
+    def SubmitRequest(self, request: bytes, context=None) -> bytes:
+        """Enqueue one generation request. Two dedup layers: the idem
+        response cache (bounded LRU) and the engine's request-id dedup —
+        a replay past the cache still cannot generate twice."""
+        header, blobs = protocol.unpack(request)
+        cached = self._idem_get(header)
+        if cached is not None:
+            return cached
+        self._inject_server_fault("SubmitRequest")
+        eng = self._servable(header["servable_id"])
+        prompt = protocol.decode_literal(header["prompt"], blobs[0])
+        out = eng.submit(
+            header["request_id"], prompt,
+            max_new_tokens=int(header["max_new_tokens"]),
+            greedy=bool(header.get("greedy", True)),
+            temperature=float(header.get("temperature", 1.0)),
+            top_k=int(header.get("top_k", 0)),
+            seed=int(header.get("seed", 0)),
+            deadline_ms=header.get("deadline_ms"))
+        return self._idem_put(header, protocol.pack({"ok": True, **out}))
+
+    def PollResult(self, request: bytes, context=None) -> bytes:
+        """Long-poll request states; a pure read (no idem token needed).
+        Generated tokens ride in the JSON header — short int lists, not
+        tensor payloads."""
+        header, _ = protocol.unpack(request)
+        self._inject_server_fault("PollResult")
+        eng = self._servable(header["servable_id"])
+        results = eng.poll(header.get("request_ids"),
+                           wait_ms=float(header.get("wait_ms", 0.0)))
+        return protocol.pack({"ok": True, "results": results})
+
+    def CancelRequest(self, request: bytes, context=None) -> bytes:
+        header, _ = protocol.unpack(request)
+        cached = self._idem_get(header)
+        if cached is not None:
+            return cached
+        self._inject_server_fault("CancelRequest")
+        eng = self._servable(header["servable_id"])
+        ok = eng.cancel(header["request_id"])
+        return self._idem_put(header,
+                              protocol.pack({"ok": True, "cancelled": ok}))
+
+    def close_servables(self) -> None:
+        """Stop every serving engine's scheduler thread (test teardown /
+        server shutdown)."""
+        for eng in list(self.servables.values()):
+            eng.stop()
+        self.servables.clear()
 
 
 def create_server(port: int, devices=None, task_index: int = 0,

@@ -485,7 +485,20 @@ class WorkerPlan:
                     key = self.meta["recv_keys"][str(tid)] + f":{step}"
                     val = self.raw.get(key)
                     if isinstance(val, PendingPull):
-                        val = val.resolve()
+                        try:
+                            val = val.resolve()
+                        except Exception as e:  # noqa: BLE001
+                            # AbortStep frees the producer's parked
+                            # buffers immediately, so a pull issued
+                            # before the abort landed fails at the
+                            # transport. Surface the ABORT, not the
+                            # secondary transport error, so the master's
+                            # recovery classifies it correctly.
+                            if self.raw._aborted:
+                                raise StepAbortedError(
+                                    f"step aborted while pulling {key!r}"
+                                ) from e
+                            raise
                         # fwd AND remat bwd re-read this key; a pull is
                         # single-use, so park the value instead.
                         self.raw.put(key, val)

@@ -1,0 +1,23 @@
+"""Continuous-batching inference serving on the TePDist RPC stack.
+
+Layers (bottom-up):
+
+  * kv_cache.py — slot-based batched KV-cache pool + length-bucketed
+    compiled prefill/decode executables (generalizes
+    models/sampling.py::init_cache to a fixed-capacity pool).
+  * engine.py  — request queue, admission control with deadlines, and
+    the Orca-style iteration-level batching scheduler.
+  * client.py  — ServeClient: LoadServable / SubmitRequest / PollResult /
+    CancelRequest over any TepdistClient transport (inproc or gRPC),
+    with round-robin placement across workers.
+"""
+
+from tepdist_tpu.serving.kv_cache import (ServableModel, SlotPool,
+                                          bucket_for, default_buckets)
+from tepdist_tpu.serving.engine import ServeRequest, ServingEngine, TERMINAL
+from tepdist_tpu.serving.client import ServeClient
+
+__all__ = [
+    "ServableModel", "SlotPool", "bucket_for", "default_buckets",
+    "ServeRequest", "ServingEngine", "TERMINAL", "ServeClient",
+]

@@ -1,0 +1,406 @@
+"""Continuous-batching inference engine: queue, admission, scheduler.
+
+Reference parity: NONE (deliberate surplus). Orca-style iteration-level
+scheduling (Yu et al., OSDI'22) over the slot pool in kv_cache.py:
+
+  * ``submit()`` enqueues a request under ADMISSION CONTROL — a bounded
+    queue (reject when full), per-request deadlines (expire un-admitted
+    requests whose deadline passed), and duplicate-id dedup (the RPC
+    retry path replays a submit whose response was lost; the engine must
+    not generate twice — ``serve_requests_deduped`` proves it didn't).
+  * ``step()`` is ONE scheduler iteration: retire/cancel finished slots,
+    admit queued requests into free slots (prefill each — its logits
+    yield the request's FIRST token, closing the TTFT span), then run
+    ONE batched decode step appending one token to every active request.
+    New requests slip in between decode steps; a finished sequence frees
+    its slot without stalling the rest of the batch.
+  * ``start()`` runs ``step()`` on a daemon scheduler thread that idles
+    on a condition variable when there is no work; tests that need
+    lockstep determinism drive ``step()``/``run_until_idle()`` directly
+    instead.
+
+Telemetry (always-on metrics; spans when tracing is enabled):
+counters   serve_requests_{submitted,completed,rejected,expired,
+           cancelled,deduped,failed}, serve_prefills, serve_decode_steps,
+           serve_tokens, serve_compiles
+gauges     serve_queue_depth, serve_slot_occupancy
+histograms serve_ttft_ms, serve_token_ms, serve_batch_size
+spans      serve:ttft (submit -> first token, one per request),
+           serve:prefill, serve:decode (one per step), serve:token (one
+           per request per decode step — its duration IS that token's
+           latency).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from tepdist_tpu.models.gpt2 import GPT2Config
+from tepdist_tpu.models.sampling import _split_data
+from tepdist_tpu.serving.kv_cache import ServableModel
+from tepdist_tpu.telemetry import metrics, span
+
+log = logging.getLogger("tepdist.serving")
+
+# Terminal request states (poll stops waiting on these).
+TERMINAL = ("done", "rejected", "expired", "cancelled", "failed")
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    rid: str
+    prompt: np.ndarray               # int32 [T]
+    max_new_tokens: int
+    greedy: bool = True
+    temperature: float = 1.0
+    top_k: int = 0
+    seed: int = 0
+    deadline_ms: Optional[float] = None
+    state: str = "queued"
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+    error: Optional[str] = None
+    t_submit: float = 0.0
+    t_deadline: Optional[float] = None
+    t_first: Optional[float] = None
+    t_done: Optional[float] = None
+    kd: Any = None                   # raw uint32 RNG key data (non-greedy)
+    pos: int = 0                     # next cache write position
+    ttft_span: Any = None
+
+    def result(self) -> Dict[str, Any]:
+        out = {
+            "request_id": self.rid,
+            "status": self.state,
+            "n_tokens": len(self.tokens),
+            "tokens": list(self.tokens),
+        }
+        if self.error:
+            out["error"] = self.error
+        if self.t_first is not None:
+            out["ttft_ms"] = round((self.t_first - self.t_submit) * 1e3, 3)
+        if self.t_done is not None:
+            out["total_ms"] = round((self.t_done - self.t_submit) * 1e3, 3)
+        return out
+
+
+class ServingEngine:
+    """One servable model + its request queue + the batching scheduler."""
+
+    def __init__(self, params, cfg: GPT2Config, *, slots: int = 4,
+                 max_len: Optional[int] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_queue: int = 64, name: str = "servable"):
+        self.model = ServableModel(params, cfg, slots=slots,
+                                   max_len=max_len, buckets=buckets,
+                                   name=name)
+        self.name = name
+        self.max_queue = int(max_queue)
+        self._reqs: Dict[str, ServeRequest] = {}
+        self._queue: deque = deque()
+        self._active: Dict[int, str] = {}        # slot -> rid
+        self._cv = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+
+    # -- client surface (thread-safe) ----------------------------------
+    def submit(self, rid: str, prompt, *, max_new_tokens: int,
+               greedy: bool = True, temperature: float = 1.0,
+               top_k: int = 0, seed: int = 0,
+               deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+        """Admission control happens here (bounded queue, validation,
+        duplicate dedup); deadline expiry happens at slot-assignment
+        time. Returns {"status": queued|rejected|duplicate, ...}."""
+        m = metrics()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        now = time.monotonic()
+        with self._cv:
+            if rid in self._reqs:
+                # RPC replay of an applied submit (or a client reusing an
+                # id): never enqueue twice — this counter is the
+                # exactly-once evidence the chaos test asserts on.
+                m.counter("serve_requests_deduped").inc()
+                return {"status": "duplicate",
+                        "state": self._reqs[rid].state}
+            m.counter("serve_requests_submitted").inc()
+            err = None
+            if prompt.size == 0:
+                err = "empty prompt"
+            elif max_new_tokens < 1:
+                err = "max_new_tokens < 1"
+            elif prompt.size + max_new_tokens > self.model.max_len:
+                err = (f"prompt+max_new_tokens "
+                       f"{prompt.size + max_new_tokens} > "
+                       f"max_len={self.model.max_len}")
+            elif len(self._queue) >= self.max_queue:
+                err = f"queue full ({self.max_queue})"
+            r = ServeRequest(
+                rid=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
+                greedy=bool(greedy), temperature=float(temperature),
+                top_k=int(top_k), seed=int(seed), deadline_ms=deadline_ms,
+                t_submit=now,
+                t_deadline=(now + deadline_ms / 1e3
+                            if deadline_ms is not None else None))
+            self._reqs[rid] = r
+            if err is not None:
+                r.state = "rejected"
+                r.error = err
+                m.counter("serve_requests_rejected").inc()
+                return {"status": "rejected", "error": err}
+            sp = span("serve:ttft", cat="serve", rid=rid,
+                      prompt_len=int(prompt.size))
+            sp.__enter__()
+            r.ttft_span = sp
+            self._queue.append(rid)
+            m.gauge("serve_queue_depth").set(len(self._queue))
+            self._cv.notify_all()
+            return {"status": "queued"}
+
+    def cancel(self, rid: str) -> bool:
+        """Cancel a queued or decoding request; terminal ones are left
+        alone (their result already stands)."""
+        with self._cv:
+            r = self._reqs.get(rid)
+            if r is None or r.state in TERMINAL:
+                return False
+            if r.slot is not None:
+                self.model.pool.release(r.slot)
+                self._active.pop(r.slot, None)
+                r.slot = None
+                metrics().gauge("serve_slot_occupancy").set(
+                    self.model.pool.n_used)
+            r.state = "cancelled"
+            r.t_done = time.monotonic()
+            metrics().counter("serve_requests_cancelled").inc()
+            self._cv.notify_all()
+            return True
+
+    def poll(self, rids: Optional[Sequence[str]] = None,
+             wait_ms: float = 0.0) -> List[Dict[str, Any]]:
+        """Snapshot request states (all requests when ``rids`` is None).
+        ``wait_ms`` blocks until every polled request is terminal (or the
+        wait expires) — long-polling keeps the RPC chatter bounded."""
+        deadline = time.monotonic() + wait_ms / 1e3
+        with self._cv:
+            while True:
+                ids = list(rids) if rids is not None else list(self._reqs)
+                reqs = [self._reqs[i] for i in ids if i in self._reqs]
+                missing = [i for i in ids if i not in self._reqs]
+                if (not wait_ms
+                        or all(r.state in TERMINAL for r in reqs)
+                        or missing):
+                    out = [r.result() for r in reqs]
+                    out += [{"request_id": i, "status": "unknown"}
+                            for i in missing]
+                    return out
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return [r.result() for r in reqs]
+                self._cv.wait(remaining)
+
+    # -- scheduler ------------------------------------------------------
+    def _has_work(self) -> bool:
+        return bool(self._queue) or bool(self._active)
+
+    def step(self) -> bool:
+        """One scheduler iteration (admit + one batched decode step).
+        Called from the scheduler thread, or directly by lockstep
+        tests/benches. Returns False when there was nothing to do."""
+        m = metrics()
+        admitted: List[ServeRequest] = []
+        with self._cv:
+            while self._queue and self.model.pool.n_free:
+                rid = self._queue.popleft()
+                r = self._reqs.get(rid)
+                if r is None or r.state != "queued":
+                    continue          # cancelled while queued
+                if (r.t_deadline is not None
+                        and time.monotonic() > r.t_deadline):
+                    r.state = "expired"
+                    r.error = f"deadline {r.deadline_ms} ms passed in queue"
+                    r.t_done = time.monotonic()
+                    m.counter("serve_requests_expired").inc()
+                    self._cv.notify_all()
+                    continue
+                r.slot = self.model.pool.alloc()
+                r.state = "active"
+                self._active[r.slot] = rid
+                admitted.append(r)
+            m.gauge("serve_queue_depth").set(len(self._queue))
+            if admitted:
+                m.gauge("serve_slot_occupancy").set(self.model.pool.n_used)
+
+        for r in admitted:
+            self._prefill_one(r)
+
+        with self._cv:
+            batch = [(slot, self._reqs[rid])
+                     for slot, rid in sorted(self._active.items())
+                     if self._reqs[rid].state == "active"]
+        if not batch:
+            return bool(admitted)
+        self._decode_once(batch)
+        return True
+
+    def _prefill_one(self, r: ServeRequest) -> None:
+        m = metrics()
+        with span("serve:prefill", cat="serve", rid=r.rid, slot=r.slot,
+                  prompt_len=int(r.prompt.size)) as sp:
+            logits, k, v, bucket = self.model.prefill(r.prompt)
+            sp.set(bucket=bucket)
+            self.model.insert(k, v, r.slot)
+            sub = None
+            if not r.greedy:
+                kd = jax.random.key_data(jax.random.PRNGKey(r.seed))
+                r.kd, sub = _split_data(kd)
+            tok = self.model.pick(logits, sub, r.temperature, r.top_k,
+                                  r.greedy)
+        m.counter("serve_prefills").inc()
+        with self._cv:
+            r.t_first = time.monotonic()
+            r.tokens.append(tok)
+            r.pos = int(r.prompt.size)
+            m.counter("serve_tokens").inc()
+            m.histogram("serve_ttft_ms").observe(
+                (r.t_first - r.t_submit) * 1e3)
+            if r.ttft_span is not None:
+                r.ttft_span.__exit__(None, None, None)
+                r.ttft_span = None
+            if len(r.tokens) >= r.max_new_tokens:
+                self._finish_locked(r)
+            self._cv.notify_all()
+
+    def _decode_once(self, batch) -> None:
+        m = metrics()
+        S = self.model.n_slots
+        tok = np.zeros(S, np.int32)
+        pos = np.zeros(S, np.int32)
+        for slot, r in batch:
+            tok[slot] = r.tokens[-1]
+            pos[slot] = r.pos
+        tok_spans = [span("serve:token", cat="serve", rid=r.rid)
+                     for _, r in batch]
+        for sp in tok_spans:
+            sp.__enter__()
+        t0 = time.perf_counter()
+        with span("serve:decode", cat="serve", batch=len(batch)):
+            logits = self.model.decode_step(tok, pos)
+            logits.block_until_ready()
+        step_ms = (time.perf_counter() - t0) * 1e3
+        picked = []
+        for slot, r in batch:
+            sub = None
+            if not r.greedy:
+                r.kd, sub = _split_data(r.kd)
+            picked.append(self.model.pick(logits[slot], sub,
+                                          r.temperature, r.top_k, r.greedy))
+        for sp in tok_spans:
+            sp.__exit__(None, None, None)
+        m.counter("serve_decode_steps").inc()
+        m.histogram("serve_batch_size").observe(len(batch))
+        with self._cv:
+            for (slot, r), tok_i in zip(batch, picked):
+                if r.state != "active":
+                    continue          # cancelled mid-step: drop the token
+                r.tokens.append(tok_i)
+                r.pos += 1
+                m.counter("serve_tokens").inc()
+                m.histogram("serve_token_ms").observe(step_ms)
+                if len(r.tokens) >= r.max_new_tokens:
+                    self._finish_locked(r)
+            self._cv.notify_all()
+
+    def _finish_locked(self, r: ServeRequest) -> None:
+        if r.slot is not None:
+            self.model.pool.release(r.slot)
+            self._active.pop(r.slot, None)
+            r.slot = None
+        r.state = "done"
+        r.t_done = time.monotonic()
+        m = metrics()
+        m.counter("serve_requests_completed").inc()
+        m.gauge("serve_slot_occupancy").set(self.model.pool.n_used)
+        m.histogram("serve_request_ms").observe(
+            (r.t_done - r.t_submit) * 1e3)
+
+    def _fail_all_locked(self, err: str) -> None:
+        for r in self._reqs.values():
+            if r.state in TERMINAL:
+                continue
+            if r.slot is not None:
+                self.model.pool.release(r.slot)
+                self._active.pop(r.slot, None)
+                r.slot = None
+            r.state = "failed"
+            r.error = err
+            r.t_done = time.monotonic()
+            metrics().counter("serve_requests_failed").inc()
+        self._queue.clear()
+        self._cv.notify_all()
+
+    def run_until_idle(self, max_steps: int = 100000) -> None:
+        """Drive the scheduler synchronously (lockstep tests/benches;
+        do not mix with ``start()``)."""
+        for _ in range(max_steps):
+            if not self._has_work():
+                return
+            self.step()
+        raise RuntimeError("run_until_idle: scheduler did not drain")
+
+    # -- scheduler thread ----------------------------------------------
+    def start(self) -> None:
+        with self._cv:
+            if self._thread is not None:
+                return
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._loop, name=f"serve-{self.name}", daemon=True)
+            self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        with self._cv:
+            t = self._thread
+            self._stop = True
+            self._cv.notify_all()
+        if t is not None:
+            t.join(timeout)
+        with self._cv:
+            self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stop and not self._has_work():
+                    self._cv.wait()
+                if self._stop:
+                    return
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — fail pollers, not hang
+                log.exception("serving scheduler step failed")
+                with self._cv:
+                    self._fail_all_locked(repr(e))
+
+    # -- introspection --------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            states: Dict[str, int] = {}
+            for r in self._reqs.values():
+                states[r.state] = states.get(r.state, 0) + 1
+            return {
+                "name": self.name,
+                "slots": self.model.n_slots,
+                "slots_used": self.model.pool.n_used,
+                "max_len": self.model.max_len,
+                "buckets": list(self.model.buckets),
+                "queue_depth": len(self._queue),
+                "requests": states,
+            }

@@ -1,0 +1,286 @@
+"""Slot-based batched KV cache + length-bucketed serving executables.
+
+Reference parity: NONE (deliberate surplus — the reference serves nothing;
+its north star "serve heavy traffic from millions of users" has no code
+behind it). This module generalizes ``models/sampling.py::init_cache``
+from a per-call [n_layer, B, H, max_len, hd] cache to a FIXED-CAPACITY
+slot pool that outlives any single request:
+
+  * ``SlotPool`` — host-side allocator over ``n_slots`` cache rows
+    (allocate on admission, release on retirement/cancel, reset wipes).
+  * ``ServableModel`` — owns the pooled ``k``/``v`` arrays plus the
+    compiled executables the continuous-batching scheduler calls:
+
+      - ``prefill(prompt)``: one request, padded to a LENGTH BUCKET so the
+        number of distinct compiled prefill programs is O(log max_len),
+        not O(#prompt lengths). Returns the first sampled-token logits and
+        the per-layer k/v stacks for the prompt.
+      - ``insert(k, v, slot)``: write a prefilled sequence into its slot.
+      - ``decode_step(tok, pos)``: ONE token for EVERY slot with per-slot
+        write positions — retired/free slots ride along masked (their
+        rows are garbage that the next occupant's prefill overwrites), so
+        the decode program compiles exactly once per (model, pool shape).
+
+    Executables are cached per (model, bucket) — the ISSUE's contract —
+    and each fresh compile increments the ``serve_compiles`` counter.
+
+Numerics contract: the per-slot decode computes the same per-row
+attention as ``sampling.sample`` (same masking convention — key position
+<= query position, same fp32 score/logit dtypes), so greedy outputs are
+token-identical to N sequential ``sample()`` calls (tests/
+test_sampling.py asserts this, including mid-stream slot reuse).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tepdist_tpu.models import gpt2, sampling
+from tepdist_tpu.models.gpt2 import GPT2Config, _layer_norm
+from tepdist_tpu.telemetry import metrics
+
+_NEG_INF = sampling._NEG_INF
+
+
+def config_to_spec(cfg: GPT2Config) -> Dict[str, Any]:
+    """JSON-able GPT2Config for the LoadServable wire header."""
+    d = dataclasses.asdict(cfg)
+    d["dtype"] = np.dtype(d["dtype"]).name
+    return d
+
+
+def config_from_spec(spec: Dict[str, Any]) -> GPT2Config:
+    d = dict(spec)
+    name = d["dtype"]
+    try:
+        d["dtype"] = np.dtype(name).type
+    except TypeError:
+        import ml_dtypes
+        d["dtype"] = getattr(ml_dtypes, name)
+    return GPT2Config(**d)
+
+
+def default_buckets(max_len: int, min_bucket: int = 8) -> List[int]:
+    """Power-of-two prompt-length buckets up to ``max_len`` (inclusive)."""
+    out = []
+    b = min_bucket
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return sorted(set(out))
+
+
+def bucket_for(length: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if length <= b:
+            return b
+    raise ValueError(f"prompt length {length} exceeds the largest bucket "
+                     f"{max(buckets)}")
+
+
+class SlotPool:
+    """Host-side slot allocator (the cache rows live in ServableModel)."""
+
+    def __init__(self, n_slots: int):
+        self.n_slots = n_slots
+        # LIFO free list: hot slots are reused first (their cache rows are
+        # most likely still resident close to the cores).
+        self._free = list(range(n_slots - 1, -1, -1))
+
+    def alloc(self) -> Optional[int]:
+        return self._free.pop() if self._free else None
+
+    def release(self, slot: int) -> None:
+        if slot in self._free:
+            raise ValueError(f"slot {slot} double-released")
+        self._free.append(slot)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        return self.n_slots - len(self._free)
+
+
+# -- traced model functions (jitted per shape by ServableModel) -------------
+
+def _prefill_impl(params, tokens, length, cfg: GPT2Config):
+    """One request: ``tokens`` [1, T_bucket] (zero-padded past ``length``),
+    -> (fp32 logits [vocab] at position ``length``-1,
+        k/v stacks [n_layer, H, T_bucket, hd]).
+
+    Reuses ``sampling._attn_with_cache`` layer-for-layer so the prompt
+    k/v and the last real position's hidden state are computed by the
+    same ops as ``sample()``'s prefill; the padded tail positions are
+    causally masked from every real position, so their garbage never
+    reaches the returned logits and is overwritten by decode writes."""
+    T = tokens.shape[1]
+    cache = sampling.init_cache(cfg, 1, T)
+    pos = jnp.arange(T)
+    x = (params["wte"][tokens] + params["wpe"][pos]).astype(cfg.dtype)
+    ks, vs = [], []
+    for i in range(cfg.n_layer):
+        blk = params[f"h{i}"]
+        a, ck, cv = sampling._attn_with_cache(
+            blk, _layer_norm(x, blk["ln1_g"], blk["ln1_b"]),
+            cache["k"][i], cache["v"][i], 0, cfg)
+        x = x + a
+        x = x + gpt2.mlp(blk, _layer_norm(x, blk["ln2_g"], blk["ln2_b"]))
+        ks.append(ck[0])
+        vs.append(cv[0])
+    last = lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)[0, 0]
+    h = _layer_norm(last, params["ln_f_g"], params["ln_f_b"])
+    logits = (h @ params["wte"].T).astype(jnp.float32)
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def _insert_impl(ck, cv, k, v, slot):
+    """Write a prefilled request ([n_layer, H, T_bucket, hd]) into its
+    pool slot; positions past the bucket keep whatever the previous
+    occupant left (masked until the new occupant's decode writes them)."""
+    k = k[:, None].astype(ck.dtype)
+    v = v[:, None].astype(cv.dtype)
+    ck = lax.dynamic_update_slice(ck, k, (0, slot, 0, 0, 0))
+    cv = lax.dynamic_update_slice(cv, v, (0, slot, 0, 0, 0))
+    return ck, cv
+
+
+def _decode_step_impl(params, tok, pos, ck, cv, cfg: GPT2Config):
+    """One decode token for EVERY slot. ``tok``/``pos`` [S]: each slot's
+    input token and its write position (free slots ride along with
+    pos=0 — their write lands on a dead row that the next prefill
+    overwrites). -> (fp32 logits [S, vocab], updated pool k/v)."""
+    S = tok.shape[0]
+    H, hd = cfg.n_head, cfg.head_dim
+    L = ck.shape[3]
+    scale = 1.0 / math.sqrt(hd)
+    x = (params["wte"][tok] + params["wpe"][pos]).astype(cfg.dtype)
+
+    def write(c, row, p):
+        # c [H, L, hd]; row [H, hd] written at position p of this slot.
+        return lax.dynamic_update_slice(
+            c, row[:, None, :].astype(c.dtype), (0, p, 0))
+
+    k_pos = lax.broadcasted_iota(jnp.int32, (S, L), 1)
+    mask = (k_pos <= pos[:, None])[:, None, :]     # [S, 1, L]
+    new_k, new_v = [], []
+    for i in range(cfg.n_layer):
+        blk = params[f"h{i}"]
+        h = _layer_norm(x, blk["ln1_g"], blk["ln1_b"])
+        qkv = h @ blk["attn_qkv_w"] + blk["attn_qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(S, H, hd)
+        cki = jax.vmap(write)(ck[i], k.reshape(S, H, hd), pos)
+        cvi = jax.vmap(write)(cv[i], v.reshape(S, H, hd), pos)
+        s = jnp.einsum("shd,shld->shl", q.astype(jnp.float32),
+                       cki.astype(jnp.float32)) * scale
+        s = jnp.where(mask, s, _NEG_INF)
+        p_ = jax.nn.softmax(s, axis=-1).astype(cvi.dtype)
+        o = jnp.einsum("shl,shld->shd", p_, cvi).reshape(S, -1)
+        x = x + (o @ blk["attn_proj_w"] + blk["attn_proj_b"])
+        x = x + gpt2.mlp(blk, _layer_norm(x, blk["ln2_g"], blk["ln2_b"]))
+        new_k.append(cki)
+        new_v.append(cvi)
+    xf = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
+    logits = (xf @ params["wte"].T).astype(jnp.float32)
+    return logits, jnp.stack(new_k), jnp.stack(new_v)
+
+
+def _pick_row_impl(logits, sub_kd, temperature, top_k: int, greedy: bool):
+    """Next-token choice for ONE request (``logits`` [vocab]) — the same
+    op sequence as ``sampling._pick`` on a B=1 row, so per-request
+    sampling matches a B=1 ``sample()`` call with the same key."""
+    return sampling._pick(logits[None], sub_kd, temperature, top_k,
+                          greedy)[0]
+
+
+class ServableModel:
+    """A loaded model + its slot pool + compiled serving executables."""
+
+    def __init__(self, params, cfg: GPT2Config, *, slots: int = 4,
+                 max_len: Optional[int] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 name: str = "servable"):
+        self.cfg = cfg
+        self.name = name
+        # Restored/shipped checkpoints hand back numpy leaves; lift once.
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        self.n_slots = int(slots)
+        self.max_len = int(max_len if max_len is not None else cfg.n_ctx)
+        if self.max_len > cfg.n_ctx:
+            raise ValueError(
+                f"max_len={self.max_len} > n_ctx={cfg.n_ctx}")
+        self.buckets = sorted({min(int(b), self.max_len)
+                               for b in (buckets
+                                         or default_buckets(self.max_len))})
+        self.pool = SlotPool(self.n_slots)
+        shape = (cfg.n_layer, self.n_slots, cfg.n_head, self.max_len,
+                 cfg.head_dim)
+        self.ck = jnp.zeros(shape, cfg.dtype)
+        self.cv = jnp.zeros(shape, cfg.dtype)
+        # Executable caches, keyed per (this model, bucket) — one compile
+        # per distinct shape for the life of the servable.
+        self._prefill_exe: Dict[int, Any] = {}
+        self._insert_exe: Dict[int, Any] = {}
+        self._decode_exe = None
+        self._pick_exe: Dict[Tuple[bool, int], Any] = {}
+
+    # -- executable cache ----------------------------------------------
+    def _compiled(self, cache, key, build):
+        fn = cache.get(key)
+        if fn is None:
+            metrics().counter("serve_compiles").inc()
+            fn = build()
+            cache[key] = fn
+        return fn
+
+    def prefill(self, prompt: np.ndarray) -> Tuple[Any, Any, Any, int]:
+        """-> (fp32 logits [vocab], k, v stacks, bucket). Pads the prompt
+        to its length bucket so compiles are bounded by len(buckets)."""
+        T = int(prompt.shape[0])
+        b = bucket_for(T, self.buckets)
+        toks = np.zeros((1, b), np.int32)
+        toks[0, :T] = np.asarray(prompt, np.int32)
+        fn = self._compiled(
+            self._prefill_exe, b,
+            lambda: jax.jit(functools.partial(_prefill_impl, cfg=self.cfg)))
+        logits, k, v = fn(self.params, jnp.asarray(toks), jnp.int32(T))
+        return logits, k, v, b
+
+    def insert(self, k, v, slot: int) -> None:
+        b = int(k.shape[2])
+        fn = self._compiled(self._insert_exe, b,
+                            lambda: jax.jit(_insert_impl))
+        self.ck, self.cv = fn(self.ck, self.cv, k, v, jnp.int32(slot))
+
+    def decode_step(self, tok: np.ndarray, pos: np.ndarray):
+        """-> fp32 logits [n_slots, vocab]; updates the pool in place."""
+        if self._decode_exe is None:
+            metrics().counter("serve_compiles").inc()
+            self._decode_exe = jax.jit(
+                functools.partial(_decode_step_impl, cfg=self.cfg))
+        logits, self.ck, self.cv = self._decode_exe(
+            self.params, jnp.asarray(tok, jnp.int32),
+            jnp.asarray(pos, jnp.int32), self.ck, self.cv)
+        return logits
+
+    def pick(self, logits_row, sub_kd, temperature: float, top_k: int,
+             greedy: bool) -> int:
+        fn = self._compiled(
+            self._pick_exe, (bool(greedy), int(top_k)),
+            lambda: jax.jit(functools.partial(
+                _pick_row_impl, top_k=int(top_k), greedy=bool(greedy))))
+        return int(fn(logits_row,
+                      None if greedy else jnp.asarray(sub_kd),
+                      jnp.float32(temperature)))

@@ -129,3 +129,25 @@ def test_explore_and_train_share_candidate_space():
                  c.get("intra_tp", 0)) for c in b["candidates"])
     assert ka == kb
     assert a["kind"] == b["kind"]
+
+
+def test_winner_lowering_postcheck_runs_on_library_path(devices):
+    """NOTES_NEXT gap #2: auto_parallel_explore's SPMD winner gets the
+    winner-only lowering post-check — diagnostics recorded on the plan
+    and folded into the winner's candidate row — and LOWERING_POSTCHECK=0
+    gates it off."""
+    loss, params, x, y = _deep_mlp(2, 32, 4, concrete=True)
+    plan = auto_parallel_explore(loss, 8, params, x, y)
+    assert isinstance(plan, ParallelPlan)
+    assert isinstance(plan.lowering_remats, list)
+    winner_rows = [c for c in plan.candidates
+                   if c.get("cost") is plan.cost]
+    if plan.lowering_remats:
+        assert winner_rows and winner_rows[0]["involuntary_remats"] \
+            == plan.lowering_remats
+    try:
+        ServiceEnv.reset({"LOWERING_POSTCHECK": False})
+        plan2 = auto_parallel_explore(loss, 8, params, x, y)
+    finally:
+        ServiceEnv.reset()
+    assert not hasattr(plan2, "lowering_remats")

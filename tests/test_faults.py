@@ -380,3 +380,35 @@ def test_chaos_run_matches_fault_free_bit_for_bit():
     # Transient survival path only: no elastic rebuild, no rollback.
     assert "elastic_redispatch" not in snap
     assert "checkpoint_rollback_steps" not in snap
+
+
+# ---------------------------------------------------------------------------
+# Abort-path transfer release (NOTES_NEXT gap #5)
+# ---------------------------------------------------------------------------
+
+def test_abort_step_frees_parked_transfers():
+    """AbortStep frees parked transfer buffers IMMEDIATELY (not lazily on
+    the next DispatchPlan): the abort latch already fails pre-abort pull
+    tickets with StepAbortedError, so holding the buffers across the
+    whole recovery window was a pure leak. The reset path must NOT free —
+    a same-step retry re-reads the raw store."""
+    from tepdist_tpu.rpc.server import TepdistServicer
+
+    metrics().reset()
+    sv = TepdistServicer(jax.devices()[:1], task_index=0)
+    sv.park_transfer(3, [np.ones(4)])
+    sv.park_transfer(4, [np.ones(4), np.ones(2)])
+    # Reset-path AbortStep (fence lift) keeps the parked buffers.
+    sv.AbortStep(protocol.pack({"reset": True}))
+    assert sum(len(v) for v in sv._parked_transfers.values()) == 2
+    # Plain AbortStep frees everything and reports it.
+    header, _ = protocol.unpack(sv.AbortStep(protocol.pack({})))
+    assert header["freed_transfers"] == 2
+    assert not sv._parked_transfers
+    snap = metrics().snapshot()["counters"]
+    assert snap.get("transfers_freed_on_abort") == 2
+    assert snap.get("transfers_parked") == snap.get("transfers_freed") == 2
+    # Post-abort: a ticket holder blocked in the raw store gets the clean
+    # aborted error, not a transport error against a freed buffer.
+    with pytest.raises(StepAbortedError):
+        sv.raw_store.get("t1:0", timeout=0.1)

@@ -1,0 +1,163 @@
+"""Serving load generator: drive a continuous-batching fleet and report
+latency/throughput (the serving analogue of tools/chaos_run.py).
+
+Spins up an in-process worker fleet (no sockets), loads a servable on
+every worker, fires a randomized request mix (prompt lengths, output
+lengths, optional deadlines) through the round-robin ServeClient, and
+prints completion counts, token throughput, and TTFT / per-token latency
+stats pulled from the always-on metrics registry. ``--fault-spec``
+injects RPC faults (runtime/faults.py grammar) under load; ``--trace``
+dumps the merged Perfetto timeline for tools/trace_summary.py.
+
+Run: python tools/serve_load.py [--requests 32 --workers 2 --slots 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def run_load(config: str = "test", workers: int = 2, slots: int = 4,
+             requests: int = 32, max_len: int = 64,
+             prompt_len: (int, int) = (3, 16),
+             max_new: (int, int) = (2, 10), seed: int = 0,
+             greedy: bool = True, deadline_ms: Optional[float] = None,
+             fault_spec: Optional[str] = None,
+             trace: Optional[str] = None,
+             timeout_s: float = 300.0) -> Dict[str, Any]:
+    import jax
+
+    from tepdist_tpu import telemetry
+    from tepdist_tpu.models import gpt2
+    from tepdist_tpu.rpc.client import TepdistClient
+    from tepdist_tpu.rpc.inproc import (close_inproc_cluster,
+                                        make_inproc_cluster)
+    from tepdist_tpu.runtime import faults
+    from tepdist_tpu.serving import ServeClient
+
+    if trace:
+        telemetry.trace.configure(enabled=True)
+    cfg = gpt2.CONFIGS[config]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    cluster, servicers = make_inproc_cluster(
+        workers, jax.devices()[:workers])
+    clients = [TepdistClient(w.address) for w in cluster.workers]
+    sc = ServeClient(clients=clients)
+    rng = np.random.RandomState(seed)
+    before = telemetry.metrics().snapshot()
+    try:
+        sc.load(params, cfg, slots=slots, max_len=max_len,
+                name="loadgen")
+        reqs: List[Dict[str, Any]] = []
+        if fault_spec:
+            faults.configure(fault_spec)
+        t0 = time.perf_counter()
+        try:
+            for i in range(requests):
+                t = int(rng.randint(prompt_len[0], prompt_len[1] + 1))
+                m = int(rng.randint(max_new[0], max_new[1] + 1))
+                m = min(m, max_len - t)
+                prompt = rng.randint(0, cfg.vocab_size,
+                                     size=t).astype(np.int32)
+                out = sc.submit(prompt, max_new_tokens=m, greedy=greedy,
+                                seed=i, deadline_ms=deadline_ms)
+                reqs.append({"rid": out["request_id"],
+                             "prompt_len": t, "max_new": m,
+                             "admission": out["status"]})
+            results = sc.wait([r["rid"] for r in reqs],
+                              timeout_s=timeout_s)
+        finally:
+            if fault_spec:
+                faults.reset()
+        wall_s = time.perf_counter() - t0
+        statuses: Dict[str, int] = {}
+        n_tokens = 0
+        ttfts = []
+        for r in reqs:
+            res = results[r["rid"]]
+            statuses[res["status"]] = statuses.get(res["status"], 0) + 1
+            n_tokens += res.get("n_tokens", 0)
+            if "ttft_ms" in res:
+                ttfts.append(res["ttft_ms"])
+        trace_path = sc.dump_trace(trace) if trace else None
+    finally:
+        for s in servicers:
+            s.close_servables()
+        close_inproc_cluster(cluster)
+    after = telemetry.metrics().snapshot()
+
+    def delta(name: str) -> int:
+        return (after["counters"].get(name, 0)
+                - before["counters"].get(name, 0))
+
+    tok_hist = after.get("histograms", {}).get("serve_token_ms", {})
+    summary = {
+        "requests": requests,
+        "statuses": statuses,
+        "wall_s": round(wall_s, 3),
+        "tokens": n_tokens,
+        "tokens_per_s": round(n_tokens / wall_s, 2) if wall_s else None,
+        "ttft_ms": {
+            "mean": round(float(np.mean(ttfts)), 3) if ttfts else None,
+            "p50": round(float(np.median(ttfts)), 3) if ttfts else None,
+            "max": round(float(np.max(ttfts)), 3) if ttfts else None,
+        },
+        "token_ms_mean": round(tok_hist.get("mean", 0.0), 3)
+        if tok_hist else None,
+        "decode_steps": delta("serve_decode_steps"),
+        "prefills": delta("serve_prefills"),
+        "compiles": delta("serve_compiles"),
+        "rpc_retries": delta("rpc_retries"),
+        "dedup_hits": delta("dedup_hits"),
+        "trace": trace_path,
+    }
+    return summary
+
+
+def main(argv=None) -> Dict[str, Any]:
+    ap = argparse.ArgumentParser("serve_load")
+    ap.add_argument("--config", default="test")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--max-len", type=int, default=64)
+    ap.add_argument("--prompt-len", type=int, nargs=2, default=(3, 16))
+    ap.add_argument("--max-new", type=int, nargs=2, default=(2, 10))
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--fault-spec", default=None,
+                    help="runtime/faults.py grammar, e.g. "
+                         "'rpc_drop:verb=SubmitRequest,p=0.3,seed=7'")
+    ap.add_argument("--trace", default=None,
+                    help="dump the merged trace JSON here")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    summary = run_load(
+        config=args.config, workers=args.workers, slots=args.slots,
+        requests=args.requests, max_len=args.max_len,
+        prompt_len=tuple(args.prompt_len), max_new=tuple(args.max_new),
+        seed=args.seed, deadline_ms=args.deadline_ms,
+        fault_spec=args.fault_spec, trace=args.trace)
+    if args.json:
+        print(json.dumps(summary, indent=1))
+    else:
+        print(f"{summary['requests']} requests -> {summary['statuses']} "
+              f"in {summary['wall_s']}s "
+              f"({summary['tokens_per_s']} tok/s)")
+        print(f"  ttft ms: {summary['ttft_ms']}  "
+              f"token ms mean: {summary['token_ms_mean']}")
+        print(f"  prefills={summary['prefills']} "
+              f"decode_steps={summary['decode_steps']} "
+              f"compiles={summary['compiles']} "
+              f"retries={summary['rpc_retries']} "
+              f"dedup={summary['dedup_hits']}")
+    return summary
+
+
+if __name__ == "__main__":
+    main()

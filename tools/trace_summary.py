@@ -131,7 +131,22 @@ def main() -> None:
         print("fault recovery:")
         for k, v in sorted(fault.items()):
             print(f"  {k:<28} {v}")
-    rest = {k: v for k, v in counters.items() if k not in fault}
+    serving = {k: v for k, v in counters.items()
+               if k.startswith("serve_")}
+    if serving:
+        print("serving:")
+        for k, v in sorted(serving.items()):
+            print(f"  {k:<28} {v}")
+        hists = (s.get("metrics") or {}).get("histograms") or {}
+        for k in ("serve_ttft_ms", "serve_token_ms", "serve_request_ms",
+                  "serve_batch_size"):
+            h = hists.get(k)
+            if h:
+                print(f"  {k:<28} mean={h['mean']:.3f} "
+                      f"min={h['min']:.3f} max={h['max']:.3f} "
+                      f"n={h['count']}")
+    rest = {k: v for k, v in counters.items()
+            if k not in fault and k not in serving}
     if rest:
         print("counters:")
         for k, v in sorted(rest.items()):
