@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Chaos smoke: a timeout-bounded, non-slow in-proc pass over the two
+# recovery ladders — run it locally or as a CI step.
+#
+#   1. TRAINING: seeded server faults on ExecuteRemotePlan exhaust the
+#      rpc retry budget and force same-step re-execution (_recover_step);
+#      asserts the loss trajectory is bit-identical to the fault-free run
+#      and prints fault_injected / rpc_retries / step_retries.
+#   2. SERVING: a seeded engine_crash plus a serve_fault mid-decode kill
+#      the engine; the ServingSupervisor rebuilds it and replays journaled
+#      requests; asserts every request ends "done" with tokens
+#      bit-identical to the fault-free run and prints engine_restarts /
+#      requests_replayed.
+#
+# Both specs are seeded, so every run injects the same faults at the same
+# points. Override the per-pass bound with CHAOS_SMOKE_TIMEOUT (seconds).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${CHAOS_SMOKE_TIMEOUT:-600}"
+
+echo "=== chaos smoke 1/2: training step-retry ==="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python tools/chaos_run.py \
+    --steps 6 --spec 'server_fault:p=0.7,verb=ExecuteRemotePlan,seed=7'
+
+echo "=== chaos smoke 2/2: serving engine-crash recovery ==="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python tools/chaos_run.py \
+    --serve --requests 10 \
+    --spec 'engine_crash:step=3,ti=0;serve_fault:op=decode,step=6,ti=1,seed=7'
+
+echo "chaos smoke: PASS"

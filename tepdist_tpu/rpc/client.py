@@ -40,7 +40,11 @@ IDEMPOTENT_TOKEN_VERBS = {"ExecutePlan", "DispatchPlan",
                           # build a second engine, a replayed SubmitRequest
                           # must not generate twice, a replayed Cancel must
                           # report the original cancel's outcome.
-                          "LoadServable", "SubmitRequest", "CancelRequest"}
+                          "LoadServable", "SubmitRequest", "CancelRequest",
+                          # A replayed Drain must answer with the ORIGINAL
+                          # handoff list — re-draining an already-drained
+                          # engine would return [] and lose the handoffs.
+                          "Drain"}
 
 
 class GRPCStub:
@@ -272,10 +276,16 @@ class TepdistClient:
                       slots: int = 4, max_len: Optional[int] = None,
                       buckets: Optional[Sequence[int]] = None,
                       max_queue: int = 64,
-                      name: str = "servable") -> str:
+                      name: str = "servable",
+                      max_restarts: int = 3,
+                      shed_high: Optional[int] = None,
+                      shed_low: Optional[int] = None) -> str:
         """Ship a model (JSON-able GPT2Config dict + flat param leaves in
-        tree_flatten order) and start its serving engine. Returns the
-        servable id used by the other serve verbs."""
+        tree_flatten order) and start its supervised serving engine.
+        Returns the servable id used by the other serve verbs.
+        ``max_restarts`` bounds supervised recovery; ``shed_high``/
+        ``shed_low`` set the overload watermark (defaults: max_queue and
+        half of it)."""
         metas, blobs = [], []
         for leaf in param_leaves:
             meta, blob = protocol.encode_literal(np.asarray(leaf))
@@ -285,7 +295,9 @@ class TepdistClient:
             "config": config, "params_meta": metas, "slots": int(slots),
             "max_len": max_len,
             "buckets": list(buckets) if buckets is not None else None,
-            "max_queue": int(max_queue), "name": name}, blobs)
+            "max_queue": int(max_queue), "name": name,
+            "max_restarts": int(max_restarts),
+            "shed_high": shed_high, "shed_low": shed_low}, blobs)
         header, _ = protocol.unpack(resp)
         return header["servable_id"]
 
@@ -326,6 +338,18 @@ class TepdistClient:
             "servable_id": servable_id, "request_id": request_id})
         header, _ = protocol.unpack(resp)
         return bool(header["cancelled"])
+
+    def drain_servable(self, servable_id: str,
+                       wait_ms: float = 0.0) -> List[Dict[str, Any]]:
+        """Gracefully drain the servable: admission stops, resident
+        slots get up to ``wait_ms`` to finish, and every un-started
+        queued request comes back as a resubmittable spec (prompt +
+        sampling params + original request id)."""
+        resp = self.call("Drain", {
+            "servable_id": servable_id, "wait_ms": float(wait_ms)},
+            timeout=retry.deadline_for("Drain") + wait_ms / 1e3)
+        header, _ = protocol.unpack(resp)
+        return header["handed_off"]
 
     # -- checkpoint ----------------------------------------------------
     def do_remote_save(self, max_to_keep: int = 5,

@@ -83,6 +83,7 @@ METHODS = [
     "SubmitRequest",
     "PollResult",
     "CancelRequest",
+    "Drain",
 ]
 
 # Reference keeps INT_MAX message sizes (client_library.cc:152-156).

@@ -63,6 +63,9 @@ DEADLINES = {
     "SubmitRequest": 30.0,
     "PollResult": 60.0,
     "CancelRequest": 15.0,
+    # Drain's budget is on top of the client-requested slot-finish wait
+    # (rpc/client.py adds wait_ms to the timeout, like PollResult).
+    "Drain": 60.0,
 }
 DEFAULT_DEADLINE = 300.0
 
@@ -159,6 +162,14 @@ def call_with_retry(send: Callable[[str, bytes, float], bytes],
     policy = policy or DEFAULT_POLICY
     attempts = max_attempts if max_attempts is not None \
         else policy.max_attempts
+    if rng is None:
+        # Under an active (seeded) fault plan, jitter is the one input
+        # that would make a chaos run non-reproducible — draw it from the
+        # plan's dedicated retry RNG instead of the global random module.
+        from tepdist_tpu.runtime import faults
+        plan = faults.active()
+        if plan is not None:
+            rng = plan.retry_rng
     delays = policy.backoff_schedule(attempts, rng=rng)
     for attempt in range(attempts):
         try:

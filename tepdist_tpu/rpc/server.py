@@ -1086,6 +1086,10 @@ class TepdistServicer:
 
     def ExecuteRemotePlan(self, request: bytes, context=None) -> bytes:
         header, _ = protocol.unpack(request)
+        # Injection BEFORE run_step: the step-result cache makes a replay
+        # of an executed step a cache hit, so a post-run fault would only
+        # exercise the rpc retry, never the master's _recover_step ladder.
+        self._inject_server_fault("ExecuteRemotePlan")
         if self.worker_plan is None:
             return protocol.pack({"ok": True, "losses": []})
         step = int(header.get("step", 0))
@@ -1268,17 +1272,19 @@ class TepdistServicer:
 
     def LoadServable(self, request: bytes, context=None) -> bytes:
         """Ship a model (config spec + flat param leaves in tree_flatten
-        order) and start its continuous-batching engine. Idempotent: a
-        replayed load answers with the original servable id instead of
-        building a second engine."""
+        order) and start its SUPERVISED continuous-batching engine
+        (serving/supervisor.py: engine faults are recovered by rebuild +
+        journal replay instead of failing in-flight requests).
+        Idempotent: a replayed load answers with the original servable
+        id instead of building a second engine."""
         header, blobs = protocol.unpack(request)
         cached = self._idem_get(header)
         if cached is not None:
             return cached
         self._inject_server_fault("LoadServable")
         from tepdist_tpu.models import gpt2
-        from tepdist_tpu.serving.engine import ServingEngine
         from tepdist_tpu.serving.kv_cache import config_from_spec
+        from tepdist_tpu.serving.supervisor import ServingSupervisor
 
         cfg = config_from_spec(header["config"])
         leaves = [protocol.decode_literal(m, blobs[i])
@@ -1291,12 +1297,16 @@ class TepdistServicer:
             sid = f"sv{self._servable_next}"
             self._servable_next += 1
         name = header.get("name") or sid
-        eng = ServingEngine(
+        eng = ServingSupervisor(
             params, cfg, slots=int(header.get("slots", 4)),
             max_len=header.get("max_len"),
             buckets=header.get("buckets"),
             max_queue=int(header.get("max_queue", 64)),
-            name=f"{name}@{self.task_index}")
+            name=f"{name}@{self.task_index}",
+            task_index=self.task_index,
+            max_restarts=int(header.get("max_restarts", 3)),
+            shed_high=header.get("shed_high"),
+            shed_low=header.get("shed_low"))
         eng.start()
         self.servables[sid] = eng
         log.info("LoadServable %s: %s", sid, eng.stats())
@@ -1346,11 +1356,29 @@ class TepdistServicer:
         return self._idem_put(header,
                               protocol.pack({"ok": True, "cancelled": ok}))
 
+    def Drain(self, request: bytes, context=None) -> bytes:
+        """Graceful drain: stop admission on the servable, let resident
+        slots finish (up to ``wait_ms``), and hand every un-started
+        queued request back as a resubmittable spec. Idempotent — a
+        replayed Drain must answer with the ORIGINAL handoff list, or a
+        lost response would lose the handed-off requests (the re-run
+        would find an already-empty queue)."""
+        header, _ = protocol.unpack(request)
+        cached = self._idem_get(header)
+        if cached is not None:
+            return cached
+        self._inject_server_fault("Drain")
+        eng = self._servable(header["servable_id"])
+        handed = eng.drain(wait_ms=float(header.get("wait_ms", 0.0)))
+        return self._idem_put(header, protocol.pack(
+            {"ok": True, "handed_off": handed}))
+
     def close_servables(self) -> None:
-        """Stop every serving engine's scheduler thread (test teardown /
-        server shutdown)."""
+        """Stop every serving engine (test teardown / server shutdown) —
+        drain-by-default: admission stops and resident slots finish
+        within the stop timeout before the scheduler thread exits."""
         for eng in list(self.servables.values()):
-            eng.stop()
+            eng.stop(drain=True)
         self.servables.clear()
 
 

@@ -184,6 +184,41 @@ class CheckpointUtil:
             raise
         return final
 
+    @staticmethod
+    def _clean_stale_tmps(step_dir: str) -> int:
+        """Remove ``*.tmp.*`` files left in ``step_dir`` by writers that
+        died mid-save (the crash window between a shard write and
+        ``_commit_step``). A tmp whose embedded writer pid is still
+        alive — including this process (another thread's in-flight
+        async save) — is left alone. Called by the next save of the
+        same step (the crashed worker's natural retry path)."""
+        n = 0
+        try:
+            names = os.listdir(step_dir)
+        except OSError:
+            return 0
+        for fn in names:
+            if ".tmp." not in fn:
+                continue
+            pid_s = fn.split(".tmp.", 1)[1].split(".", 1)[0]
+            try:
+                pid = int(pid_s)
+            except ValueError:
+                continue
+            if pid == os.getpid():
+                continue
+            try:
+                os.kill(pid, 0)
+                continue                  # writer alive: not stale
+            except ProcessLookupError:
+                pass                      # dead: stale
+            except OSError:
+                continue                  # EPERM etc: someone else's, skip
+            with contextlib.suppress(OSError):
+                os.unlink(os.path.join(step_dir, fn))
+                n += 1
+        return n
+
     def _commit_step(self, step: int) -> None:
         if not self.own_manifest:
             return
@@ -209,6 +244,7 @@ class CheckpointUtil:
         fetched and written ONE AT A TIME (bounded host memory)."""
         step_dir = os.path.join(self.dir, f"step_{step:012d}")
         os.makedirs(step_dir, exist_ok=True)
+        self._clean_stale_tmps(step_dir)
         final = self._write_streaming(step_dir, worker_id,
                                       self._stream_entries(variables))
         self._commit_step(step)
@@ -225,6 +261,7 @@ class CheckpointUtil:
         snapshot = list(self._stream_entries(variables))
         step_dir = os.path.join(self.dir, f"step_{step:012d}")
         os.makedirs(step_dir, exist_ok=True)
+        self._clean_stale_tmps(step_dir)
         handle = AsyncSaveHandle(step)
 
         def run():

@@ -36,12 +36,26 @@ Kinds:
                    moment it is asked to execute step >= N. Exercises the
                    permanent/elastic escalation path, not the transient
                    retry path.
+  ``serve_fault``  [``op=prefill|decode``] (``step=`` | ``p=``) [``ti=``]
+                   — raised inside the serving engine's compute path
+                   (serving/engine.py). ``step=N`` fires exactly once, at
+                   the Nth matching prefill/decode op this rule observes
+                   (deterministic: the engine's scheduler is single-
+                   threaded per worker); ``p=`` draws from the plan RNG.
+  ``engine_crash`` ``step=`` [``ti=``] — the serving engine dies (its
+                   scheduler iteration raises) at its Nth scheduler step.
+                   Fires ONCE per rule, so the supervisor-restarted
+                   replacement engine is not re-killed at the same step.
 
 ``seed=`` on any rule seeds the whole plan (default 0); all probability
 draws come from one ``random.Random`` under a lock, so a single-threaded
-call sequence is exactly reproducible (the determinism unit test). Every
-fired rule increments ``fault_injected`` (and ``fault_injected:<kind>``)
-in the telemetry registry.
+call sequence is exactly reproducible (the determinism unit test). The
+plan also carries ``retry_rng``, a second RNG (derived from the same
+seed) that ``rpc/retry.py`` uses for backoff jitter whenever a plan is
+active — keeping the fault draw sequence independent of how many retries
+happen, and the retry sleeps themselves reproducible. Every fired rule
+increments ``fault_injected`` (and ``fault_injected:<kind>``) in the
+telemetry registry.
 
 The active plan is parsed lazily from ``TEPDIST_FAULT_SPEC`` on first use;
 tests (and tools/chaos_run.py) install one directly with ``configure()``.
@@ -73,12 +87,14 @@ class InjectedFault(ConnectionError):
 @dataclasses.dataclass
 class FaultRule:
     kind: str                      # rpc_drop | rpc_delay | server_fault |
-                                   # raw_drop | worker_crash
+                                   # raw_drop | worker_crash | serve_fault |
+                                   # engine_crash
     p: float = 1.0
-    verb: Optional[str] = None     # None = any RPC verb
+    verb: Optional[str] = None     # None = any RPC verb (serve_fault: op)
     ti: Optional[int] = None       # None = any worker
     ms: float = 0.0                # rpc_delay only
-    step: Optional[int] = None     # worker_crash only
+    step: Optional[int] = None     # worker_crash / serve_fault /
+                                   # engine_crash
 
     def matches(self, verb: Optional[str], ti: Optional[int]) -> bool:
         if self.verb is not None and self.verb != verb:
@@ -95,8 +111,14 @@ class FaultPlan:
         self.rules = rules
         self.seed = seed
         self._rng = random.Random(seed)
+        # Separate stream for retry backoff jitter: retries must not
+        # perturb the fault draw sequence (and vice versa) or two chaos
+        # runs with different retry counts would diverge.
+        self.retry_rng = random.Random(seed ^ 0x5EED0FF5)
         self._lock = threading.Lock()
         self._crashed: set = set()
+        self._serve_op_counts: Dict[int, int] = {}   # rule idx -> #ops seen
+        self._fired_once: set = set()                # rule idxs (step rules)
 
     # -- parsing -------------------------------------------------------
     @classmethod
@@ -129,17 +151,32 @@ class FaultPlan:
                     kwargs[k] = int(v)
                 elif k == "verb":
                     kwargs["verb"] = v
+                elif k == "op":
+                    # serve_fault's op filter rides the verb field.
+                    if v not in ("prefill", "decode"):
+                        raise ValueError(
+                            f"TEPDIST_FAULT_SPEC: op must be prefill|"
+                            f"decode, got {v!r} in {part!r}")
+                    kwargs["verb"] = v
                 else:
                     raise ValueError(
                         f"TEPDIST_FAULT_SPEC: unknown key {k!r} in {part!r}")
             if kind not in ("rpc_drop", "rpc_delay", "server_fault",
-                            "raw_drop", "worker_crash"):
+                            "raw_drop", "worker_crash", "serve_fault",
+                            "engine_crash"):
                 raise ValueError(
                     f"TEPDIST_FAULT_SPEC: unknown fault kind {kind!r}")
             if kind == "worker_crash" and ("step" not in kwargs
                                            or "ti" not in kwargs):
                 raise ValueError(
                     "TEPDIST_FAULT_SPEC: worker_crash needs step= and ti=")
+            if kind == "engine_crash" and "step" not in kwargs:
+                raise ValueError(
+                    "TEPDIST_FAULT_SPEC: engine_crash needs step=")
+            if kind == "serve_fault" and ("step" not in kwargs
+                                          and "p" not in kwargs):
+                raise ValueError(
+                    "TEPDIST_FAULT_SPEC: serve_fault needs step= or p=")
             rules.append(FaultRule(kind=kind, **kwargs))  # type: ignore
         return cls(rules, seed=seed)
 
@@ -192,6 +229,52 @@ class FaultPlan:
                 raise InjectedFault(
                     f"injected raw-transfer drop (worker {ti})",
                     kind="raw_drop")
+
+    # -- serving hooks -------------------------------------------------
+    def serve_op(self, op: str, ti: Optional[int] = None) -> None:
+        """Consulted by the serving engine before each prefill/decode
+        computation; raises InjectedFault when a matching ``serve_fault``
+        rule fires. ``step=N`` rules count only the ops THEY match (op +
+        ti filters applied first), so the Nth matching op is deterministic
+        regardless of what other workers/ops do."""
+        for i, r in enumerate(self.rules):
+            if r.kind != "serve_fault" or not r.matches(op, ti):
+                continue
+            if r.step is not None:
+                with self._lock:
+                    n = self._serve_op_counts.get(i, 0) + 1
+                    self._serve_op_counts[i] = n
+                    fire = n == r.step and i not in self._fired_once
+                    if fire:
+                        self._fired_once.add(i)
+            else:
+                fire = self._roll(r.p)
+            if fire:
+                self._count("serve_fault")
+                raise InjectedFault(
+                    f"injected serve fault in {op} (worker {ti})",
+                    kind="serve_fault")
+
+    def engine_crash_on_step(self, ti: Optional[int], step: int) -> bool:
+        """Consulted by the serving engine at the top of each scheduler
+        iteration (``step`` is the engine's own 1-based counter). A
+        matching ``engine_crash`` rule fires exactly once — the
+        supervisor's replacement engine restarts its counter but must not
+        be re-killed at the same step, or no recovery would ever
+        succeed."""
+        for i, r in enumerate(self.rules):
+            if r.kind != "engine_crash":
+                continue
+            if r.ti is not None and r.ti != ti:
+                continue
+            if r.step is not None and step >= r.step:
+                with self._lock:
+                    if i in self._fired_once:
+                        continue
+                    self._fired_once.add(i)
+                self._count("engine_crash")
+                return True
+        return False
 
     # -- crash rules ---------------------------------------------------
     def has_crash_rule(self, ti: Optional[int]) -> bool:

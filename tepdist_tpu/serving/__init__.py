@@ -7,17 +7,25 @@ Layers (bottom-up):
     models/sampling.py::init_cache to a fixed-capacity pool).
   * engine.py  — request queue, admission control with deadlines, and
     the Orca-style iteration-level batching scheduler.
+  * supervisor.py — ServingSupervisor: engine lifecycle + request
+    journal; on an engine fault it rebuilds the engine and replays
+    in-flight requests (greedy ones re-prefilled from prompt+prefix,
+    bit-identically), sheds load past a queue watermark, and only
+    fails requests once the restart budget is spent.
   * client.py  — ServeClient: LoadServable / SubmitRequest / PollResult /
-    CancelRequest over any TepdistClient transport (inproc or gRPC),
-    with round-robin placement across workers.
+    CancelRequest / Drain over any TepdistClient transport (inproc or
+    gRPC), with round-robin placement, a per-replica circuit breaker,
+    and failover past open/overloaded/draining replicas.
 """
 
 from tepdist_tpu.serving.kv_cache import (ServableModel, SlotPool,
                                           bucket_for, default_buckets)
 from tepdist_tpu.serving.engine import ServeRequest, ServingEngine, TERMINAL
-from tepdist_tpu.serving.client import ServeClient
+from tepdist_tpu.serving.supervisor import ServingSupervisor
+from tepdist_tpu.serving.client import ServeClient, ServeOverloadError
 
 __all__ = [
     "ServableModel", "SlotPool", "bucket_for", "default_buckets",
-    "ServeRequest", "ServingEngine", "TERMINAL", "ServeClient",
+    "ServeRequest", "ServingEngine", "TERMINAL", "ServingSupervisor",
+    "ServeClient", "ServeOverloadError",
 ]

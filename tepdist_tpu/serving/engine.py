@@ -18,6 +18,17 @@ scheduling (Yu et al., OSDI'22) over the slot pool in kv_cache.py:
     on a condition variable when there is no work; tests that need
     lockstep determinism drive ``step()``/``run_until_idle()`` directly
     instead.
+  * ``drain()`` stops admission, hands un-started queued requests back
+    to the caller (for resubmission on another replica) and optionally
+    waits for resident slots to finish — ``stop()`` drains by default.
+  * Fault ladder: a step failure on a SUPERVISED engine (``on_fault``
+    set, see supervisor.py) marks the engine dead and escalates — the
+    supervisor rebuilds and replays, and ``_fail_all_locked`` is its
+    last rung, not the first response. An UNSUPERVISED engine keeps the
+    pre-supervisor contract: fail every in-flight request (releasing
+    their slots — lockstep callers must not leak SlotPool capacity) and
+    keep serving new submissions. ``serve_fault``/``engine_crash`` rules
+    in ``TEPDIST_FAULT_SPEC`` inject into exactly these paths.
 
 Telemetry (always-on metrics; spans when tracing is enabled):
 counters   serve_requests_{submitted,completed,rejected,expired,
@@ -38,20 +49,24 @@ import logging
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
 
 from tepdist_tpu.models.gpt2 import GPT2Config
 from tepdist_tpu.models.sampling import _split_data
+from tepdist_tpu.runtime import faults
 from tepdist_tpu.serving.kv_cache import ServableModel
 from tepdist_tpu.telemetry import metrics, span
 
 log = logging.getLogger("tepdist.serving")
 
-# Terminal request states (poll stops waiting on these).
-TERMINAL = ("done", "rejected", "expired", "cancelled", "failed")
+# Terminal request states (poll stops waiting on these). "drained" =
+# handed back un-started by drain() for resubmission elsewhere; "shed" =
+# refused by the supervisor's overload watermark (supervisor.py).
+TERMINAL = ("done", "rejected", "expired", "cancelled", "failed",
+            "drained", "shed")
 
 
 @dataclasses.dataclass
@@ -98,18 +113,27 @@ class ServingEngine:
     def __init__(self, params, cfg: GPT2Config, *, slots: int = 4,
                  max_len: Optional[int] = None,
                  buckets: Optional[Sequence[int]] = None,
-                 max_queue: int = 64, name: str = "servable"):
+                 max_queue: int = 64, name: str = "servable",
+                 task_index: Optional[int] = None,
+                 on_fault: Optional[Callable[[BaseException], None]]
+                 = None):
         self.model = ServableModel(params, cfg, slots=slots,
                                    max_len=max_len, buckets=buckets,
                                    name=name)
         self.name = name
         self.max_queue = int(max_queue)
+        self.task_index = task_index      # fault-rule ti filter target
+        self.on_fault = on_fault          # set => supervised (ladder up)
         self._reqs: Dict[str, ServeRequest] = {}
         self._queue: deque = deque()
         self._active: Dict[int, str] = {}        # slot -> rid
         self._cv = threading.Condition()
         self._thread: Optional[threading.Thread] = None
         self._stop = False
+        self._draining = False
+        self._dead = False
+        self._error: Optional[str] = None
+        self._steps = 0                   # scheduler iterations (1-based)
 
     # -- client surface (thread-safe) ----------------------------------
     def submit(self, rid: str, prompt, *, max_new_tokens: int,
@@ -130,6 +154,15 @@ class ServingEngine:
                 m.counter("serve_requests_deduped").inc()
                 return {"status": "duplicate",
                         "state": self._reqs[rid].state}
+            if self._dead:
+                # No record is kept: a dead engine must not claim rids
+                # the supervisor's replacement will own.
+                return {"status": "rejected",
+                        "error": f"engine dead: {self._error}"}
+            if self._draining:
+                # Honest backpressure, not a terminal record: the caller
+                # resubmits the same rid on another replica.
+                return {"status": "draining"}
             m.counter("serve_requests_submitted").inc()
             err = None
             if prompt.size == 0:
@@ -210,12 +243,42 @@ class ServingEngine:
     def _has_work(self) -> bool:
         return bool(self._queue) or bool(self._active)
 
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
     def step(self) -> bool:
         """One scheduler iteration (admit + one batched decode step).
         Called from the scheduler thread, or directly by lockstep
-        tests/benches. Returns False when there was nothing to do."""
+        tests/benches. Returns False when there was nothing to do.
+
+        On ANY failure (injected or real): a supervised engine is marked
+        dead and the exception escalates to ``on_fault`` (via ``_loop``)
+        or the lockstep driver; an unsupervised engine fails every
+        in-flight request — releasing their slots, so direct ``step()``
+        callers can't leak SlotPool capacity — and stays serviceable."""
+        try:
+            return self._step_inner()
+        except Exception as e:  # noqa: BLE001 — ladder decides below
+            with self._cv:
+                if self.on_fault is not None:
+                    self._dead = True
+                    self._error = repr(e)
+                else:
+                    self._fail_all_locked(repr(e))
+            raise
+
+    def _step_inner(self) -> bool:
         m = metrics()
         admitted: List[ServeRequest] = []
+        with self._cv:
+            self._steps += 1
+        plan = faults.active()
+        if plan is not None and plan.engine_crash_on_step(
+                self.task_index, self._steps):
+            raise faults.InjectedFault(
+                f"injected engine crash at scheduler step {self._steps} "
+                f"(worker {self.task_index})", kind="engine_crash")
         with self._cv:
             while self._queue and self.model.pool.n_free:
                 rid = self._queue.popleft()
@@ -252,6 +315,9 @@ class ServingEngine:
 
     def _prefill_one(self, r: ServeRequest) -> None:
         m = metrics()
+        plan = faults.active()
+        if plan is not None:
+            plan.serve_op("prefill", self.task_index)
         with span("serve:prefill", cat="serve", rid=r.rid, slot=r.slot,
                   prompt_len=int(r.prompt.size)) as sp:
             logits, k, v, bucket = self.model.prefill(r.prompt)
@@ -280,6 +346,9 @@ class ServingEngine:
 
     def _decode_once(self, batch) -> None:
         m = metrics()
+        plan = faults.active()
+        if plan is not None:
+            plan.serve_op("decode", self.task_index)
         S = self.model.n_slots
         tok = np.zeros(S, np.int32)
         pos = np.zeros(S, np.int32)
@@ -332,6 +401,11 @@ class ServingEngine:
             (r.t_done - r.t_submit) * 1e3)
 
     def _fail_all_locked(self, err: str) -> None:
+        """The LAST rung of the fault ladder: every non-terminal request
+        fails (its slot returned to the pool) and the queue empties.
+        Supervised engines only reach this via the supervisor after the
+        restart budget is exhausted."""
+        m = metrics()
         for r in self._reqs.values():
             if r.state in TERMINAL:
                 continue
@@ -339,12 +413,61 @@ class ServingEngine:
                 self.model.pool.release(r.slot)
                 self._active.pop(r.slot, None)
                 r.slot = None
+            if r.ttft_span is not None:
+                r.ttft_span.__exit__(None, None, None)
+                r.ttft_span = None
             r.state = "failed"
             r.error = err
             r.t_done = time.monotonic()
-            metrics().counter("serve_requests_failed").inc()
+            m.counter("serve_requests_failed").inc()
         self._queue.clear()
+        m.gauge("serve_queue_depth").set(0)
+        m.gauge("serve_slot_occupancy").set(self.model.pool.n_used)
         self._cv.notify_all()
+
+    # -- drain ----------------------------------------------------------
+    def drain(self, wait_ms: float = 0.0) -> List[Dict[str, Any]]:
+        """Graceful drain: stop admission, hand every un-started queued
+        request back to the caller (terminal state "drained"; the specs
+        returned here are resubmittable on another replica under the
+        SAME request id), then wait up to ``wait_ms`` for resident slots
+        to finish decoding. Threaded engines keep stepping while we
+        wait; lockstep callers pass ``wait_ms=0`` and drive
+        ``run_until_idle()`` themselves."""
+        m = metrics()
+        handed: List[Dict[str, Any]] = []
+        with self._cv:
+            self._draining = True
+            while self._queue:
+                rid = self._queue.popleft()
+                r = self._reqs.get(rid)
+                if r is None or r.state != "queued":
+                    continue
+                if r.ttft_span is not None:
+                    r.ttft_span.__exit__(None, None, None)
+                    r.ttft_span = None
+                r.state = "drained"
+                r.t_done = time.monotonic()
+                handed.append({
+                    "request_id": r.rid,
+                    "prompt": [int(t) for t in r.prompt],
+                    "max_new_tokens": r.max_new_tokens,
+                    "greedy": r.greedy,
+                    "temperature": r.temperature,
+                    "top_k": r.top_k,
+                    "seed": r.seed,
+                    "deadline_ms": r.deadline_ms,
+                })
+                m.counter("drain_handoffs").inc()
+            m.gauge("serve_queue_depth").set(0)
+            self._cv.notify_all()
+            deadline = time.monotonic() + wait_ms / 1e3
+            while self._active:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+        return handed
 
     def run_until_idle(self, max_steps: int = 100000) -> None:
         """Drive the scheduler synchronously (lockstep tests/benches;
@@ -365,12 +488,27 @@ class ServingEngine:
                 target=self._loop, name=f"serve-{self.name}", daemon=True)
             self._thread.start()
 
-    def stop(self, timeout: float = 10.0) -> None:
+    def stop(self, timeout: float = 10.0, drain: bool = True) -> None:
+        """Stop the scheduler thread; by default DRAIN first (stop
+        admission, let resident slots finish within ``timeout``) so a
+        routine shutdown strands no half-decoded request. ``drain=False``
+        is the hard-stop path (supervisor discarding a dead engine)."""
+        with self._cv:
+            t = self._thread
+            dead = self._dead
+        me = threading.current_thread()
+        if drain and not dead and t is not None and t is not me:
+            try:
+                self.drain(wait_ms=timeout * 1e3)
+            except Exception:  # noqa: BLE001 — shutdown must proceed
+                log.exception("drain during stop failed")
         with self._cv:
             t = self._thread
             self._stop = True
             self._cv.notify_all()
-        if t is not None:
+        # The supervisor calls stop() from the dying engine's own
+        # scheduler thread (on_fault runs there): joining would deadlock.
+        if t is not None and t is not me:
             t.join(timeout)
         with self._cv:
             self._thread = None
@@ -384,10 +522,23 @@ class ServingEngine:
                     return
             try:
                 self.step()
-            except Exception as e:  # noqa: BLE001 — fail pollers, not hang
+            except Exception as e:  # noqa: BLE001 — ladder, not hang
                 log.exception("serving scheduler step failed")
-                with self._cv:
-                    self._fail_all_locked(repr(e))
+                cb = self.on_fault
+                if cb is not None:
+                    # Supervised: step() marked us dead; hand the corpse
+                    # to the supervisor (it rebuilds + replays on THIS
+                    # thread) and exit — this engine is done.
+                    try:
+                        cb(e)
+                    except Exception:  # noqa: BLE001
+                        log.exception("engine fault handler failed")
+                        with self._cv:
+                            self._fail_all_locked(repr(e))
+                    return
+                # Unsupervised: step() already failed all in-flight
+                # requests; keep serving new submissions (pre-supervisor
+                # contract).
 
     # -- introspection --------------------------------------------------
     def stats(self) -> Dict[str, Any]:
@@ -403,4 +554,7 @@ class ServingEngine:
                 "buckets": list(self.model.buckets),
                 "queue_depth": len(self._queue),
                 "requests": states,
+                "draining": self._draining,
+                "dead": self._dead,
+                "scheduler_steps": self._steps,
             }

@@ -237,6 +237,21 @@ class ServableModel:
         self._pick_exe: Dict[Tuple[bool, int], Any] = {}
 
     # -- executable cache ----------------------------------------------
+    def adopt_executables(self, other: "ServableModel") -> None:
+        """Take over a same-shaped model's compiled executables (the
+        supervisor's engine rebuild path: the jitted functions close over
+        nothing engine-specific — params/caches are arguments — so a
+        replacement engine skips recompiling and restarts in
+        milliseconds). Shape mismatch keeps the fresh empty caches."""
+        if (other.cfg != self.cfg or other.n_slots != self.n_slots
+                or other.max_len != self.max_len
+                or list(other.buckets) != list(self.buckets)):
+            return
+        self._prefill_exe = dict(other._prefill_exe)
+        self._insert_exe = dict(other._insert_exe)
+        self._decode_exe = other._decode_exe
+        self._pick_exe = dict(other._pick_exe)
+
     def _compiled(self, cache, key, build):
         fn = cache.get(key)
         if fn is None:

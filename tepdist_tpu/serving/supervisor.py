@@ -1,0 +1,367 @@
+"""ServingSupervisor: engine lifecycle, request journal, replay, shedding.
+
+Reference parity: NONE (deliberate surplus). The PR 3 recovery ladder
+(retry -> same-step re-execute -> elastic re-dispatch) protects the
+training plane; this is its serving-plane counterpart. The supervisor
+owns the ``ServingEngine`` the RPC verbs talk to, and turns an engine
+fault — which the bare engine could only answer with
+``_fail_all_locked`` — into a supervised restart:
+
+  * Every ADMITTED request is journaled in memory (prompt, sampling
+    params, seed, plus the tokens emitted by any engine generation that
+    died under it). The journal is the replay source, not the engine's
+    own ``_reqs`` — a dead engine's state is snapshotted once and
+    discarded.
+  * On an engine fault (``on_fault`` from the scheduler thread, or an
+    exception out of a lockstep ``step()``), the supervisor rebuilds a
+    FRESH engine + SlotPool — adopting the dead engine's compiled
+    executables, so the restart costs milliseconds, not a recompile —
+    and resubmits every non-terminal request under its original id:
+
+      - greedy requests are RE-PREFILLED from ``prompt + emitted
+        prefix`` with correspondingly fewer ``max_new_tokens``; on this
+        stack that continuation is BIT-IDENTICAL to the uninterrupted
+        run (tests/test_serving_chaos.py asserts it), so a crash is
+        invisible in the output stream.
+      - seeded-sampling requests restart from the original prompt with
+        the original seed: the per-request RNG split chain
+        (sampling._split_data) is a pure function of (seed, position),
+        so full regeneration is deterministic — resuming mid-chain from
+        a re-prefill is not, hence replay-from-scratch.
+
+    Terminal results trapped in the dead engine (finished but not yet
+    polled) are carried forward and answered from the supervisor, so a
+    restart can neither lose nor re-deliver a finished result.
+  * The restart budget (``max_restarts``) is the ladder: only when it
+    is exhausted does the supervisor fall to ``_fail_all_locked`` —
+    the last rung, not the first response.
+  * Admission passes through a HIGH/LOW queue watermark (overload
+    protection): at ``shed_high`` queued requests the supervisor starts
+    answering ``{"status": "shed"}`` — a typed refusal the client's
+    circuit breaker (serving/client.py) understands — and keeps
+    shedding until the queue falls to ``shed_low`` (hysteresis, so the
+    admission decision doesn't flap per-request). Shed requests are NOT
+    journaled and leave no engine record: the same id can be
+    resubmitted to another replica.
+
+Counters: ``engine_restarts``, ``requests_replayed``, ``serve_shed``
+(plus everything the engine already emits).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tepdist_tpu.models.gpt2 import GPT2Config
+from tepdist_tpu.serving.engine import TERMINAL, ServingEngine
+from tepdist_tpu.telemetry import metrics
+
+log = logging.getLogger("tepdist.serving")
+
+
+@dataclasses.dataclass
+class _JournalEntry:
+    """Everything needed to resubmit a request to a fresh engine."""
+    rid: str
+    prompt: np.ndarray
+    max_new_tokens: int
+    greedy: bool
+    temperature: float
+    top_k: int
+    seed: int
+    deadline_ms: Optional[float]
+    prefix: List[int] = dataclasses.field(default_factory=list)
+    replays: int = 0
+
+
+class ServingSupervisor:
+    """Owns one ServingEngine generation at a time; same client surface
+    (submit/cancel/poll/drain/stats/start/stop/step/run_until_idle), so
+    the RPC servicer talks to the supervisor exactly as it talked to the
+    bare engine."""
+
+    def __init__(self, params, cfg: GPT2Config, *, slots: int = 4,
+                 max_len: Optional[int] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_queue: int = 64, name: str = "servable",
+                 task_index: Optional[int] = None,
+                 max_restarts: int = 3,
+                 shed_high: Optional[int] = None,
+                 shed_low: Optional[int] = None):
+        self._params = params
+        self._cfg = cfg
+        self._engine_kwargs = dict(slots=slots, max_len=max_len,
+                                   buckets=buckets, max_queue=max_queue,
+                                   name=name)
+        self.name = name
+        self.task_index = task_index
+        self.max_restarts = int(max_restarts)
+        self.shed_high = int(shed_high if shed_high is not None
+                             else max_queue)
+        self.shed_low = int(shed_low if shed_low is not None
+                            else max(1, self.shed_high // 2))
+        if not 0 < self.shed_low <= self.shed_high:
+            raise ValueError(
+                f"need 0 < shed_low <= shed_high, got "
+                f"{self.shed_low}/{self.shed_high}")
+        # RLock: _recover runs under it and calls submit-adjacent engine
+        # methods; poll/submit from RPC threads serialize against it.
+        self._lock = threading.RLock()
+        self._journal: Dict[str, _JournalEntry] = {}
+        self._completed: Dict[str, Dict[str, Any]] = {}  # dead-gen results
+        self._shedding = False
+        self._threaded = False
+        self.restarts = 0
+        self.engine = self._make_engine()
+
+    # -- engine lifecycle ----------------------------------------------
+    def _make_engine(self, old: Optional[ServingEngine] = None
+                     ) -> ServingEngine:
+        eng = ServingEngine(self._params, self._cfg,
+                            task_index=self.task_index,
+                            on_fault=self._on_engine_fault,
+                            **self._engine_kwargs)
+        if old is not None:
+            eng.model.adopt_executables(old.model)
+        return eng
+
+    def start(self) -> None:
+        with self._lock:
+            self._threaded = True
+            self.engine.start()
+
+    def stop(self, timeout: float = 10.0, drain: bool = True) -> None:
+        with self._lock:
+            self._threaded = False
+            eng = self.engine
+        eng.stop(timeout=timeout, drain=drain)
+
+    # -- admission (shedding watermark, then the engine) ----------------
+    def submit(self, rid: str, prompt, **kwargs) -> Dict[str, Any]:
+        """Admission: dedup/carried-result passthrough, then the shed
+        watermark, then the engine. A submit can race the window between
+        an engine marking itself dead (scheduler thread, engine lock) and
+        ``_recover`` swapping in the replacement (supervisor lock): a
+        dead-engine rejection is retried briefly instead of bounced to
+        the caller — unless the restart budget is spent, in which case
+        dead is permanent. A dead engine keeps no record of the rid, so
+        the retry cannot double-admit."""
+        deadline = time.monotonic() + 5.0
+        while True:
+            out = self._submit_once(rid, prompt, **kwargs)
+            if not (out.get("status") == "rejected"
+                    and "engine dead" in out.get("error", "")):
+                return out
+            with self._lock:
+                if self.restarts >= self.max_restarts:
+                    return out
+            if time.monotonic() > deadline:  # pragma: no cover — stalled
+                return out
+            time.sleep(0.005)
+
+    def _submit_once(self, rid: str, prompt, **kwargs) -> Dict[str, Any]:
+        with self._lock:
+            eng = self.engine
+            if rid in self._journal or rid in self._completed:
+                # Replay of an applied submit: let the engine's dedup
+                # answer (and count) it; results carried from a dead
+                # generation answer directly.
+                if rid in self._completed:
+                    metrics().counter("serve_requests_deduped").inc()
+                    return {"status": "duplicate",
+                            "state": self._completed[rid]["status"]}
+                return eng.submit(rid, prompt, **kwargs)
+            depth = eng.queue_depth()
+            if self._shedding and depth <= self.shed_low:
+                self._shedding = False
+            if self._shedding or depth >= self.shed_high:
+                self._shedding = True
+                metrics().counter("serve_shed").inc()
+                return {"status": "shed",
+                        "error": (f"queue depth {depth} over high "
+                                  f"watermark {self.shed_high}")}
+            out = eng.submit(rid, prompt, **kwargs)
+            if out["status"] == "queued":
+                self._journal[rid] = _JournalEntry(
+                    rid=rid,
+                    prompt=np.asarray(prompt, np.int32).reshape(-1),
+                    max_new_tokens=int(kwargs["max_new_tokens"]),
+                    greedy=bool(kwargs.get("greedy", True)),
+                    temperature=float(kwargs.get("temperature", 1.0)),
+                    top_k=int(kwargs.get("top_k", 0)),
+                    seed=int(kwargs.get("seed", 0)),
+                    deadline_ms=kwargs.get("deadline_ms"))
+            return out
+
+    def cancel(self, rid: str) -> bool:
+        with self._lock:
+            eng = self.engine
+        return eng.cancel(rid)
+
+    # -- poll (journal-aware, restart-proof) ----------------------------
+    def _merge_prefix(self, res: Dict[str, Any]) -> Dict[str, Any]:
+        e = self._journal.get(res.get("request_id"))
+        if e is None or not e.prefix or "tokens" not in res:
+            return res
+        res = dict(res)
+        res["tokens"] = list(e.prefix) + list(res["tokens"])
+        res["n_tokens"] = len(res["tokens"])
+        return res
+
+    def _poll_once(self, rids: Optional[Sequence[str]]
+                   ) -> List[Dict[str, Any]]:
+        # Entirely under the supervisor lock (the engine poll is a
+        # non-blocking snapshot): a snapshot can never interleave with a
+        # recovery half-way through moving a prefix into the journal.
+        with self._lock:
+            out = []
+            seen = set()
+            for r in self.engine.poll(rids, wait_ms=0.0):
+                rid = r.get("request_id")
+                seen.add(rid)
+                if r.get("status") == "unknown" \
+                        and rid in self._completed:
+                    out.append(self._completed[rid])
+                else:
+                    out.append(self._merge_prefix(r))
+            if rids is None:
+                out.extend(v for k, v in self._completed.items()
+                           if k not in seen)
+            return out
+
+    def poll(self, rids: Optional[Sequence[str]] = None,
+             wait_ms: float = 0.0) -> List[Dict[str, Any]]:
+        """Engine-generation-proof long-poll: waits in short slices and
+        re-reads ``self.engine`` each round, so a poller blocked across
+        a supervised restart wakes up against the replacement engine
+        instead of a corpse's condition variable."""
+        deadline = time.monotonic() + wait_ms / 1e3
+        while True:
+            out = self._poll_once(rids)
+            done = all(r.get("status") in TERMINAL + ("unknown",)
+                       for r in out)
+            remaining = deadline - time.monotonic()
+            if not wait_ms or done or remaining <= 0:
+                return out
+            eng = self.engine
+            with eng._cv:
+                eng._cv.wait(min(0.05, remaining))
+
+    # -- drain ----------------------------------------------------------
+    def drain(self, wait_ms: float = 0.0) -> List[Dict[str, Any]]:
+        with self._lock:
+            eng = self.engine
+        return eng.drain(wait_ms=wait_ms)
+
+    # -- recovery -------------------------------------------------------
+    def _on_engine_fault(self, exc: BaseException) -> None:
+        """Engine fault callback — runs on the DYING engine's scheduler
+        thread (or a lockstep driver's thread via step())."""
+        self._recover(exc)
+
+    def _recover(self, exc: BaseException) -> None:
+        with self._lock:
+            old = self.engine
+            if old._thread is not None \
+                    and old._thread is not threading.current_thread():
+                # A lockstep driver raced the scheduler thread; only one
+                # recovery per corpse.
+                return
+            if self.restarts >= self.max_restarts:
+                log.error("serving engine fault after %d restarts; "
+                          "failing in-flight requests", self.restarts)
+                with old._cv:
+                    old._fail_all_locked(
+                        f"engine dead after {self.restarts} restarts: "
+                        f"{exc!r}")
+                return
+            self.restarts += 1
+            metrics().counter("engine_restarts").inc()
+            log.warning("serving engine fault (%r): restart %d/%d",
+                        exc, self.restarts, self.max_restarts)
+            old.stop(timeout=0.0, drain=False)
+            with old._cv:
+                dead_reqs = list(old._reqs.values())
+            new = self._make_engine(old=old)
+            replay: List[_JournalEntry] = []
+            for r in dead_reqs:
+                e = self._journal.get(r.rid)
+                if r.state in TERMINAL:
+                    # Finished-but-unpolled results must survive the
+                    # corpse: exactly-once delivery.
+                    res = r.result()
+                    if e is not None and e.prefix and "tokens" in res:
+                        res["tokens"] = list(e.prefix) + res["tokens"]
+                        res["n_tokens"] = len(res["tokens"])
+                    self._completed[r.rid] = res
+                    continue
+                if e is None:      # pragma: no cover — journal invariant
+                    continue
+                if e.greedy:
+                    # Accumulate across generations: a request may
+                    # survive several crashes.
+                    e.prefix = list(e.prefix) + list(r.tokens)
+                else:
+                    e.prefix = []
+                replay.append(e)
+            # Replays bypass the queue bound: every one of them was
+            # already admitted once (queued + resident can exceed
+            # max_queue alone).
+            new.max_queue = max(new.max_queue, len(replay))
+            for e in replay:
+                prompt = (np.concatenate(
+                    [e.prompt, np.asarray(e.prefix, np.int32)])
+                    if e.prefix else e.prompt)
+                out = new.submit(
+                    e.rid, prompt,
+                    max_new_tokens=e.max_new_tokens - len(e.prefix),
+                    greedy=e.greedy, temperature=e.temperature,
+                    top_k=e.top_k, seed=e.seed, deadline_ms=e.deadline_ms)
+                e.replays += 1
+                metrics().counter("requests_replayed").inc()
+                if out["status"] != "queued":  # pragma: no cover
+                    log.error("replay of %s not admitted: %s", e.rid, out)
+            self.engine = new
+            if self._threaded:
+                new.start()
+
+    # -- lockstep driving (tests/benches) -------------------------------
+    def step(self) -> bool:
+        with self._lock:
+            eng = self.engine
+        try:
+            return eng.step()
+        except Exception as e:  # noqa: BLE001 — supervised ladder
+            log.exception("lockstep serving step failed")
+            self._recover(e)
+            return True
+
+    def run_until_idle(self, max_steps: int = 100000) -> None:
+        for _ in range(max_steps):
+            with self._lock:
+                eng = self.engine
+            if not eng._has_work():
+                return
+            self.step()
+        raise RuntimeError("run_until_idle: scheduler did not drain")
+
+    # -- introspection ---------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            eng = self.engine
+            out = eng.stats()
+            out.update({
+                "restarts": self.restarts,
+                "shedding": self._shedding,
+                "shed_high": self.shed_high,
+                "shed_low": self.shed_low,
+                "journal": len(self._journal),
+                "carried_results": len(self._completed),
+            })
+            return out

@@ -89,6 +89,69 @@ def test_shard_assembly_incomplete_coverage_raises(tmp_path):
         util.restore(worker_id=0)
 
 
+def test_crash_mid_save_keeps_last_committed_step(tmp_path, monkeypatch):
+    """A writer dying between the shard write and the manifest commit
+    must not corrupt the keep-queue: the manifest stays at the last
+    committed step, restore resolves there, and the natural retry (the
+    next save of the same step) commits normally."""
+    util = CheckpointUtil(str(tmp_path))
+    util.save(1, {"x": np.array([1.0])})
+
+    def boom(self, step):
+        raise RuntimeError("simulated crash before manifest commit")
+
+    monkeypatch.setattr(CheckpointUtil, "_commit_step", boom)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        util.save(2, {"x": np.array([2.0])})
+    monkeypatch.undo()
+    assert util.steps() == [1]               # uncommitted step invisible
+    data, step = util.restore()
+    assert step == 1 and data["x"][0] == 1.0
+    util.save(2, {"x": np.array([2.0])})     # retry commits
+    assert util.steps() == [1, 2]
+    data, step = util.restore()
+    assert step == 2 and data["x"][0] == 2.0
+
+
+def _dead_pid() -> int:
+    """A pid with no live process behind it (probed, not guessed)."""
+    pid = 4_000_000
+    while pid > 2:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return pid
+        except OSError:
+            pass                             # EPERM: someone's — skip
+        pid -= 7919
+    raise RuntimeError("no dead pid found")  # pragma: no cover
+
+
+def test_stale_tmp_cleanup_on_next_save(tmp_path):
+    """Tmps left by a writer that DIED mid-save (no except-path unlink
+    ran) are removed by the next save of the same step; tmps whose
+    writer pid is alive — including this process — and non-tmp files are
+    left alone."""
+    util = CheckpointUtil(str(tmp_path))
+    util.save(3, {"x": np.array([1.0])})
+    step_dir = tmp_path / "step_000000000003"
+    stale = step_dir / f"worker0.npz.tmp.{_dead_pid()}.140234.99"
+    stale.write_bytes(b"partial write from a dead process")
+    own = step_dir / f"worker1.npz.tmp.{os.getpid()}.1.2"
+    own.write_bytes(b"another thread's in-flight save")
+    weird = step_dir / "worker2.npz.tmp.notapid"
+    weird.write_bytes(b"unparseable: leave it")
+    util.save(3, {"x": np.array([2.0])})     # same-step retry cleans
+    assert not stale.exists()
+    assert own.exists() and weird.exists()
+    data, step = util.restore(3)
+    assert step == 3 and data["x"][0] == 2.0
+    # Direct contract: only the dead-pid tmp counts as stale.
+    stale.write_bytes(b"again")
+    assert CheckpointUtil._clean_stale_tmps(str(step_dir)) == 1
+    assert CheckpointUtil._clean_stale_tmps("/nonexistent-dir") == 0
+
+
 def test_save_sharded_pytree_round_trip(tmp_path, devices):
     """Pytree save/restore through the jax-Array path, including a mesh-
     sharded leaf (single-controller: fully addressable, stored whole)."""

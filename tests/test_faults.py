@@ -13,6 +13,7 @@ checkpoint rollbacks and ``rpc_retries > 0``.
 """
 
 import random
+import time
 
 import jax
 import jax.numpy as jnp
@@ -94,6 +95,89 @@ def test_worker_crash_rule_latches():
     assert plan.crash_on_step(1, 3)
     assert plan.is_crashed(1)         # latched: every later call fails
     assert plan.crash_on_step(1, 0)   # even for earlier steps now
+
+
+def test_serving_fault_spec_parse_and_validation():
+    plan = faults.FaultPlan.parse(
+        "engine_crash:step=3,ti=0;serve_fault:op=decode,step=5,seed=9")
+    assert plan.seed == 9
+    assert plan.rules[0].kind == "engine_crash"
+    assert plan.rules[0].step == 3 and plan.rules[0].ti == 0
+    # serve_fault's op filter rides the verb field.
+    assert plan.rules[1].verb == "decode" and plan.rules[1].step == 5
+    with pytest.raises(ValueError, match="engine_crash needs"):
+        faults.FaultPlan.parse("engine_crash:ti=0")
+    with pytest.raises(ValueError, match="serve_fault needs"):
+        faults.FaultPlan.parse("serve_fault:op=decode")
+    with pytest.raises(ValueError, match="op must be"):
+        faults.FaultPlan.parse("serve_fault:op=warmup,step=1")
+
+
+def test_engine_crash_fires_once_per_rule():
+    """The supervisor's replacement engine restarts its step counter —
+    the rule that killed generation 1 must not kill generation 2, or no
+    recovery could ever succeed."""
+    plan = faults.FaultPlan.parse("engine_crash:step=3,ti=0")
+    assert not plan.engine_crash_on_step(0, 2)      # below threshold
+    assert not plan.engine_crash_on_step(1, 3)      # ti filter
+    assert plan.engine_crash_on_step(0, 3)          # fires
+    assert not plan.engine_crash_on_step(0, 3)      # once only
+    assert not plan.engine_crash_on_step(0, 4)      # stays fired
+
+
+def test_serve_fault_step_counts_matching_ops_only():
+    """step=N counts only the ops the rule MATCHES (op + ti filters
+    first), so the Nth matching op is deterministic regardless of what
+    other workers or the other op kind do — and fires once."""
+    plan = faults.FaultPlan.parse("serve_fault:op=decode,step=2,ti=1")
+    plan.serve_op("prefill", 1)        # wrong op: not counted
+    plan.serve_op("decode", 0)         # wrong worker: not counted
+    plan.serve_op("decode", 1)         # matching op #1
+    with pytest.raises(faults.InjectedFault) as ei:
+        plan.serve_op("decode", 1)     # matching op #2: fires
+    assert ei.value.kind == "serve_fault"
+    plan.serve_op("decode", 1)         # fired once: never again
+
+
+def test_retry_jitter_deterministic_under_fault_plan(monkeypatch):
+    """Chaos-run reproducibility: with a seeded plan active,
+    call_with_retry draws backoff jitter from the plan's DEDICATED
+    retry_rng — two identically-seeded plans produce identical sleep
+    sequences, and the retries do not perturb the plan's fault-draw
+    stream."""
+    spec = "rpc_drop:p=0.5,seed=13"
+
+    def run_retries():
+        sleeps = []
+        monkeypatch.setattr(time, "sleep", sleeps.append)
+        calls = []
+
+        def send(method, payload, timeout):
+            calls.append(1)
+            if len(calls) < 4:
+                raise ConnectionError("flaky")
+            return b"ok"
+
+        out = retry.call_with_retry(send, "DispatchPlan", b"x", 5.0)
+        assert out == b"ok" and len(sleeps) == 3
+        return sleeps
+
+    plan_a = faults.configure(spec)
+    sleeps_a = run_retries()
+    plan_b = faults.configure(spec)      # fresh, identically seeded
+    sleeps_b = run_retries()
+    assert sleeps_a == sleeps_b          # jitter is part of the seed
+    # Same-seed plans share one retry stream; a different seed diverges.
+    assert faults.FaultPlan.parse(spec).retry_rng.random() \
+        == faults.FaultPlan.parse(spec).retry_rng.random()
+    other = faults.FaultPlan.parse("rpc_drop:p=0.5,seed=14")
+    assert other.retry_rng.random() \
+        != faults.FaultPlan.parse(spec).retry_rng.random()
+    # Fault draws were untouched by the retries: plan_b (which just did
+    # 3 jitter draws) matches a virgin plan's rpc_action sequence.
+    virgin = faults.FaultPlan.parse(spec)
+    assert [plan_b.rpc_action("ExecutePlan") for _ in range(100)] \
+        == [virgin.rpc_action("ExecutePlan") for _ in range(100)]
 
 
 def test_env_spec_activation(monkeypatch):

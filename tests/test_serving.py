@@ -183,6 +183,83 @@ def test_cancel_queued_and_active(params):
     np.testing.assert_array_equal(np.asarray(res["c"]["tokens"]), ref)
 
 
+def test_step_failure_releases_slots_and_engine_survives(params):
+    """Slot-leak regression: an UNSUPERVISED engine whose step dies
+    mid-decode fails every in-flight request, returns ALL their slots to
+    the pool, and keeps serving new submissions at full capacity."""
+    eng = ServingEngine(params, CFG, slots=2, max_len=32)
+    p = np.arange(1, 6, dtype=np.int32)
+    for i in range(3):
+        assert eng.submit(f"r{i}", p, max_new_tokens=4)["status"] \
+            == "queued"
+    eng.step()              # r0/r1 resident, r2 queued
+    assert eng.model.pool.n_used == 2
+    faults.configure("serve_fault:op=decode,step=1")
+    with pytest.raises(faults.InjectedFault):
+        eng.step()
+    faults.configure(None)
+    assert eng.model.pool.n_used == 0 and eng.model.pool.n_free == 2
+    res = {r["request_id"]: r for r in eng.poll()}
+    assert all(r["status"] == "failed" for r in res.values())
+    # Still serviceable, and BOTH slots usable (no silent capacity loss).
+    for i in range(2):
+        assert eng.submit(f"after{i}", p, max_new_tokens=2)["status"] \
+            == "queued"
+    eng.run_until_idle()
+    res = {r["request_id"]: r for r in eng.poll(["after0", "after1"])}
+    assert all(r["status"] == "done" for r in res.values())
+
+
+def test_cancel_is_idempotent_in_counters(params):
+    """serve_requests_cancelled counts each cancel ONCE: repeated
+    cancels of the same rid and cancels of already-terminal requests are
+    refused without incrementing."""
+    eng = ServingEngine(params, CFG, slots=1, max_len=32)
+    p = np.arange(1, 6, dtype=np.int32)
+    before = _counters()
+    eng.submit("a", p, max_new_tokens=8)
+    eng.submit("b", p, max_new_tokens=2)
+    eng.step()                       # a resident, b queued
+    assert eng.cancel("a")
+    assert not eng.cancel("a")       # replayed cancel: terminal, refused
+    eng.run_until_idle()             # b completes
+    assert not eng.cancel("b")       # done is terminal too
+    d = lambda k: _counters().get(k, 0) - before.get(k, 0)  # noqa: E731
+    assert d("serve_requests_cancelled") == 1
+
+
+def test_cancel_rpc_replay_answered_from_idem_cache(params):
+    """A replayed CancelRequest (same idem token) is answered from the
+    server's dedup cache byte-for-byte — the engine's cancel path runs
+    once, and a terminal-rid cancel replay stays a counted-zero no-op."""
+    from tepdist_tpu.rpc import protocol
+
+    cluster, servicers = make_inproc_cluster(1)
+    c = TepdistClient(cluster.workers[0].address)
+    sc = ServeClient(clients=[c])
+    try:
+        sc.load(params, CFG, slots=1, max_len=32, name="cancel-idem")
+        sid = sc._placements[0][1]
+        p = np.arange(1, 6, dtype=np.int32)
+        rid = sc.submit(p, max_new_tokens=2)["request_id"]
+        sc.wait([rid], timeout_s=60)
+        before = _counters()
+        assert sc.cancel(rid) is False            # terminal: refused
+        hdr = {"servable_id": sid, "request_id": rid,
+               "idem": "test:CancelRequest:1"}
+        r1 = c.call("CancelRequest", dict(hdr))
+        r2 = c.call("CancelRequest", dict(hdr))
+        assert r1 == r2
+        assert protocol.unpack(r1)[0]["cancelled"] is False
+        d = lambda k: _counters().get(k, 0) - before.get(k, 0)  # noqa: E731
+        assert d("serve_requests_cancelled") == 0  # never double-counted
+        assert d("dedup_hits") >= 1
+    finally:
+        for s in servicers:
+            s.close_servables()
+        close_inproc_cluster(cluster)
+
+
 def test_scheduler_thread_drains_and_idles(params):
     """start()/stop() lifecycle: the daemon scheduler drains submissions
     while the caller only polls."""

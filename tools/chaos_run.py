@@ -13,10 +13,20 @@ training steps, and then compares against a clean baseline bit-for-bit.
 Exit code 0 = survived with an identical trajectory and no checkpoint
 rollback; the fault/retry counters are printed either way.
 
+``--serve`` switches to the SERVING chaos mode: the same two-worker
+in-proc fleet runs the continuous-batching service instead, a fixed
+greedy request mix is generated under injected serving faults
+(``engine_crash``/``serve_fault`` rules kill the engine mid-decode; the
+ServingSupervisor rebuilds and replays), and the generated tokens are
+compared bit-for-bit against the fault-free run — the serving analogue
+of the loss-trajectory assertion.
+
 Examples:
     python tools/chaos_run.py
     python tools/chaos_run.py --steps 20 --spec 'rpc_drop:p=0.3,seed=1'
     python tools/chaos_run.py --spec 'rpc_drop:p=0.2,seed=7;rpc_delay:ms=5'
+    python tools/chaos_run.py --serve --requests 10 \
+        --spec 'engine_crash:step=3,ti=0;serve_fault:op=decode,step=6,ti=1'
 """
 
 from __future__ import annotations
@@ -78,6 +88,85 @@ def run_fleet(steps: int, stages: int, micro: int, spec=None):
         close_inproc_cluster(cluster)
 
 
+def run_serve(requests: int, workers: int, slots: int, spec=None):
+    """One serving pass: fixed request mix, returns [(rid_index, status,
+    tokens)] plus leaves counters in the registry for the caller."""
+    from tepdist_tpu.models import gpt2
+    from tepdist_tpu.rpc.client import TepdistClient
+    from tepdist_tpu.rpc.inproc import (close_inproc_cluster,
+                                        make_inproc_cluster)
+    from tepdist_tpu.runtime import faults
+    from tepdist_tpu.serving import ServeClient
+
+    cfg = gpt2.CONFIGS["test"]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(1234)
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           size=int(rng.randint(3, 12))).astype(np.int32)
+               for _ in range(requests)]
+    cluster, servicers = make_inproc_cluster(workers)
+    sc = ServeClient(clients=[TepdistClient(w.address)
+                              for w in cluster.workers])
+    try:
+        sc.load(params, cfg, slots=slots, max_len=32, name="chaos")
+        if spec:
+            faults.configure(spec)
+        rids = [sc.submit(p, max_new_tokens=6)["request_id"]
+                for p in prompts]
+        results = sc.wait(rids, timeout_s=300)
+        return [(i, results[r]["status"], tuple(results[r].get("tokens",
+                                                               ())))
+                for i, r in enumerate(rids)]
+    finally:
+        faults.configure(None)
+        for s in servicers:
+            s.close_servables()
+        close_inproc_cluster(cluster)
+
+
+def serve_chaos(args) -> int:
+    from tepdist_tpu.telemetry import metrics
+
+    print(f"serve baseline: {args.requests} fault-free requests "
+          f"({args.stages} workers, {args.slots} slots)")
+    baseline = run_serve(args.requests, args.stages, args.slots)
+    metrics().reset()
+    print(f"serve chaos:    same mix under {args.spec!r}")
+    chaotic = run_serve(args.requests, args.stages, args.slots,
+                        spec=args.spec)
+
+    counters = metrics().snapshot()["counters"]
+    print("serving recovery counters:")
+    for k in sorted(counters):
+        if (k.split(":")[0] in ("fault_injected", "rpc_retries",
+                                "engine_restarts", "requests_replayed",
+                                "drain_handoffs", "serve_shed",
+                                "serve_breaker_trips")
+                or k in ("serve_requests_deduped",
+                         "serve_requests_failed")):
+            print(f"  {k:<32} {counters[k]}")
+
+    ok = True
+    if any(s != "done" for _, s, _ in chaotic):
+        ok = False
+        print(f"FAIL: non-done terminal states under chaos: "
+              f"{[(i, s) for i, s, _ in chaotic if s != 'done']}")
+    if chaotic != baseline:
+        ok = False
+        print("FAIL: generated tokens diverged under chaos")
+        for (i, sa, ta), (_, sb, tb) in zip(baseline, chaotic):
+            if (sa, ta) != (sb, tb):
+                print(f"  req {i}: clean={sa}:{ta} chaos={sb}:{tb}")
+    else:
+        print(f"{args.requests} requests bit-identical across "
+              f"{counters.get('engine_restarts', 0)} engine restart(s), "
+              f"{counters.get('requests_replayed', 0)} replay(s)")
+    if args.spec and not counters.get("fault_injected"):
+        print("WARN: fault plan never fired (spec too mild for this run)")
+    print("PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser("chaos_run")
     ap.add_argument("--steps", type=int, default=10)
@@ -85,9 +174,23 @@ def main() -> int:
                     help="pipeline stages = in-proc workers")
     ap.add_argument("--micro", type=int, default=2,
                     help="micro-batches per step")
-    ap.add_argument("--spec", default="rpc_drop:p=0.2,seed=7",
+    ap.add_argument("--spec", default=None,
                     help="TEPDIST_FAULT_SPEC grammar (runtime/faults.py)")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving chaos mode: engine-crash recovery + "
+                         "token bit-identity instead of training steps")
+    ap.add_argument("--requests", type=int, default=10,
+                    help="(--serve) request count")
+    ap.add_argument("--slots", type=int, default=2,
+                    help="(--serve) KV-cache slots per worker")
     args = ap.parse_args()
+    if args.serve:
+        if args.spec is None:
+            args.spec = ("engine_crash:step=3,ti=0;"
+                         "serve_fault:op=decode,step=6,ti=1,seed=7")
+        return serve_chaos(args)
+    if args.spec is None:
+        args.spec = "rpc_drop:p=0.2,seed=7"
 
     from tepdist_tpu.telemetry import metrics
 

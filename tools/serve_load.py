@@ -114,6 +114,11 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
         "compiles": delta("serve_compiles"),
         "rpc_retries": delta("rpc_retries"),
         "dedup_hits": delta("dedup_hits"),
+        "shed": delta("serve_shed"),
+        "engine_restarts": delta("engine_restarts"),
+        "requests_replayed": delta("requests_replayed"),
+        "drain_handoffs": delta("drain_handoffs"),
+        "breaker_trips": delta("serve_breaker_trips"),
         "trace": trace_path,
     }
     return summary
@@ -156,6 +161,11 @@ def main(argv=None) -> Dict[str, Any]:
               f"compiles={summary['compiles']} "
               f"retries={summary['rpc_retries']} "
               f"dedup={summary['dedup_hits']}")
+        print(f"  shed={summary['shed']} "
+              f"engine_restarts={summary['engine_restarts']} "
+              f"replayed={summary['requests_replayed']} "
+              f"drain_handoffs={summary['drain_handoffs']} "
+              f"breaker_trips={summary['breaker_trips']}")
     return summary
 
 

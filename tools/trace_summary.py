@@ -131,12 +131,21 @@ def main() -> None:
         print("fault recovery:")
         for k, v in sorted(fault.items()):
             print(f"  {k:<28} {v}")
+    # Serving recovery/overload counters don't share the serve_ prefix
+    # (engine_restarts etc. name the mechanism, not the plane).
+    SERVING_EXTRA = ("engine_restarts", "requests_replayed",
+                     "drain_handoffs")
     serving = {k: v for k, v in counters.items()
-               if k.startswith("serve_")}
+               if k.startswith("serve_") or k in SERVING_EXTRA}
     if serving:
         print("serving:")
         for k, v in sorted(serving.items()):
             print(f"  {k:<28} {v}")
+        gauges = (s.get("metrics") or {}).get("gauges") or {}
+        for k in ("serve_breaker_open", "serve_queue_depth",
+                  "serve_slot_occupancy"):
+            if k in gauges:
+                print(f"  {k:<28} {gauges[k]} (gauge)")
         hists = (s.get("metrics") or {}).get("histograms") or {}
         for k in ("serve_ttft_ms", "serve_token_ms", "serve_request_ms",
                   "serve_batch_size"):
