@@ -92,7 +92,13 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
     ("TEPDIST_TRACE", bool, False, "record step/planner spans for the "
      "merged Perfetto timeline (telemetry/); DEBUG implies it"),
     ("TEPDIST_TRACE_CAPACITY", int, 65536, "span ring-buffer capacity per "
-     "process (oldest spans are dropped)"),
+     "process (oldest spans are dropped; the overflow count is exported "
+     "as spans_dropped)"),
+    ("TEPDIST_CALIB_PROFILE", str, "", "path to a calibration-profile "
+     "JSON (telemetry/calibrate.py, written by tools/fidelity_report.py "
+     "--save-profile); when set, the evaluator and TaskScheduler price "
+     "tasks with MEASURED constants (host floor, bandwidths, compute "
+     "scale) instead of spec-sheet defaults"),
     ("LOWERING_POSTCHECK", bool, True, "winner-only involuntary-remat "
      "lowering check after exploration (parallel/lowering_check.py); "
      "records the involuntary_remat counter + a warning"),

@@ -67,6 +67,15 @@ GB = 1e9
 ALPHA_S = 2e-6
 
 
+def _calib():
+    """The active calibration profile (telemetry/calibrate.py) or None.
+    Lazy import: calibrate has no module-level dependency on this module,
+    but keeping the import inside the call avoids any telemetry<->parallel
+    import cycle and costs one cached-module lookup."""
+    from tepdist_tpu.telemetry.calibrate import active_profile
+    return active_profile()
+
+
 class PerfUtils:
     """Alpha-beta ring-cost formulas over an ICI axis of ``n`` chips.
 
@@ -77,6 +86,11 @@ class PerfUtils:
 
     @staticmethod
     def _bw(spec: TpuChipSpec, over_dcn: bool) -> float:
+        prof = _calib()
+        if prof is not None and prof.ar_bytes_per_s > 0:
+            # Measured ring bandwidth replaces the spec-sheet link math —
+            # the profile already folds in topology and software overhead.
+            return prof.ar_bytes_per_s
         # Bidirectional ring: 2 links usable per axis direction on a torus.
         return (spec.dcn_gbps if over_dcn else 2.0 * spec.ici_gbps_per_link) * GB
 
@@ -118,6 +132,9 @@ class PerfUtils:
     def ppermute_cost(cls, bytes_: float, spec: TpuChipSpec | None = None,
                       over_dcn: bool = False) -> float:
         """One neighbor hop (ring attention / pipeline send-recv)."""
+        prof = _calib()
+        if prof is not None and prof.transfer_bytes_per_s > 0:
+            return ALPHA_S + bytes_ / prof.transfer_bytes_per_s
         spec = spec or chip_spec()
         return ALPHA_S + bytes_ / (spec.ici_gbps_per_link * GB if not over_dcn
                                    else spec.dcn_gbps * GB)
@@ -126,9 +143,17 @@ class PerfUtils:
     def compute_time(cls, flops: float, spec: TpuChipSpec | None = None,
                      mxu_util: float = 0.5) -> float:
         spec = spec or chip_spec()
-        return flops / (spec.bf16_tflops * 1e12 * mxu_util)
+        t = flops / (spec.bf16_tflops * 1e12 * mxu_util)
+        prof = _calib()
+        if prof is not None and prof.compute_scale > 0:
+            t *= prof.compute_scale
+        return t
 
     @classmethod
     def hbm_time(cls, bytes_: float, spec: TpuChipSpec | None = None) -> float:
         spec = spec or chip_spec()
-        return bytes_ / (spec.hbm_gbps * GB)
+        t = bytes_ / (spec.hbm_gbps * GB)
+        prof = _calib()
+        if prof is not None and prof.hbm_scale > 0:
+            t *= prof.hbm_scale
+        return t

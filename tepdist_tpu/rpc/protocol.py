@@ -61,6 +61,8 @@ from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
+from tepdist_tpu.telemetry.trace import span
+
 SERVICE_NAME = "tepdist.TepdistService"
 
 METHODS = [
@@ -134,21 +136,29 @@ def unpack(data: bytes) -> Tuple[Dict[str, Any], List[bytes]]:
 
 
 # -- literals (arrays) as (meta, blob) pairs -------------------------------
+#
+# serde spans feed the host_serde bucket of the fidelity attribution
+# (telemetry/fidelity.py) — the round-5 probe's ~31 ms/step Python serde
+# verdict, measured permanently. Disabled tracing costs one branch.
 
 def encode_literal(x) -> Tuple[Dict[str, Any], bytes]:
-    arr = np.asarray(x)
-    return ({"dtype": arr.dtype.name, "shape": list(arr.shape)},
-            np.ascontiguousarray(arr).tobytes())
+    with span("serde:encode", cat="serde") as sp:
+        arr = np.asarray(x)
+        blob = np.ascontiguousarray(arr).tobytes()
+        sp.set(bytes=len(blob))
+        return ({"dtype": arr.dtype.name, "shape": list(arr.shape)}, blob)
 
 
 def decode_literal(meta: Dict[str, Any], blob: bytes) -> np.ndarray:
-    name = meta["dtype"]
-    try:
-        dt = np.dtype(name)
-    except TypeError:
-        import ml_dtypes
-        dt = np.dtype(getattr(ml_dtypes, name))
-    return np.frombuffer(blob, dtype=dt).reshape(meta["shape"])
+    with span("serde:decode", cat="serde") as sp:
+        name = meta["dtype"]
+        try:
+            dt = np.dtype(name)
+        except TypeError:
+            import ml_dtypes
+            dt = np.dtype(getattr(ml_dtypes, name))
+        sp.set(bytes=len(blob))
+        return np.frombuffer(blob, dtype=dt).reshape(meta["shape"])
 
 
 def method_path(name: str) -> str:

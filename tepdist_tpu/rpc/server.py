@@ -1251,14 +1251,16 @@ class TepdistServicer:
         from tepdist_tpu import telemetry
 
         header, _ = protocol.unpack(request)
-        spans = telemetry.tracer().snapshot(
-            clear=bool(header.get("clear")))
+        t = telemetry.tracer()
+        dropped = t.dropped
+        spans = t.snapshot(clear=bool(header.get("clear")))
         return protocol.pack({
             "ok": True,
             "task_index": self.task_index,
             "now_us": time.time_ns() // 1000,
             "enabled": telemetry.enabled(),
             "spans": spans,
+            "spans_dropped": dropped,
             "metrics": telemetry.metrics().snapshot(),
         })
 

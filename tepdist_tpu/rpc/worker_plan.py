@@ -380,8 +380,11 @@ class WorkerPlan:
                 tt = task["type"]
                 tid = task["node_id"]
                 s = task["stage"]
+                # task id + worker make the predicted-vs-measured join
+                # exact (telemetry/fidelity.py keys on args["task"]).
                 with span(task["name"], cat=tt, stage=s,
-                          micro=task.get("micro"), step=step) as sp:
+                          micro=task.get("micro"), step=step, task=tid,
+                          worker=self.task_index) as sp:
                     try:
                         self._run_one(task, tt, tid, s, step, outputs,
                                       losses, stage_args, sp)

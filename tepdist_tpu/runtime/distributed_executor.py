@@ -81,7 +81,11 @@ class DistributedPipelineSession:
         # Pseudo device groups: one per worker (cross-worker placement).
         stage_devices = [(self.stage_worker[s],) for s in range(S)]
         self.dag, self.maps = build_pipeline_task_dag(prog, stage_devices)
-        sched = TaskScheduler(self.dag).schedule()
+        # Kept for fidelity reporting: dump_trace() embeds the predicted
+        # per-task timeline so the merged trace is a self-contained
+        # predicted-vs-measured input (telemetry/fidelity.py).
+        self.schedule = TaskScheduler(self.dag).schedule()
+        sched = self.schedule
         order = sched.order
 
         # Per-worker ordered task lists + send routing.
@@ -562,18 +566,29 @@ class DistributedPipelineSession:
         for c in self.clients.values():
             c.do_remote_restore(global_step=global_step)
 
-    def dump_trace(self, path=None, clear: bool = False):
+    def dump_trace(self, path=None, clear: bool = False,
+                   include_predicted: bool = True):
         """Pull every worker's span buffer + metrics (GetTelemetry),
         clock-align them (NTP-midpoint offset from the round-trip), and
         write ONE merged Perfetto-loadable timeline — the fleet view the
         one-off fleet_overhead_probe reconstructed by hand. ``path=None``
         lands in ``$TEPDIST_DUMP_DIR``; returns the written path or None.
-        Dead workers are skipped, not fatal."""
+        Dead workers are skipped, not fatal. The simulator's predicted
+        timeline rides in the trace metadata (``fidelity.predicted``) so
+        tools/fidelity_report.py and trace_summary.py can join
+        predicted-vs-measured offline from the file alone."""
         from tepdist_tpu.telemetry import dump_merged_trace
         live = [c for ti, c in sorted(self.clients.items())
                 if ti not in self.health.dead]
+        extra = None
+        if include_predicted:
+            extra = {"fidelity": {
+                "predicted": self.schedule.predicted_timeline(self.dag),
+                "makespan_ms": self.schedule.makespan * 1e3,
+                "policy": self.schedule.policy,
+            }}
         return dump_merged_trace(live, path=path, name="trace",
-                                 clear=clear)
+                                 clear=clear, extra_metadata=extra)
 
     @classmethod
     def resume(cls, prog, cluster, params_template, optimizer=None,

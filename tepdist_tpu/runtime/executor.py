@@ -700,7 +700,8 @@ class PipelineExecutable:
             tt = node.task_type
             s, m = node.stage, node.micro
             sp = (span(node.name, cat=_SPAN_CAT.get(tt, "data"),
-                       stage=s, micro=m).__enter__()
+                       stage=s, micro=m, task=tid,
+                       step=self.global_step).__enter__()
                   if tracing else _NULL_SPAN)
             if tt in (TaskType.SPLIT, TaskType.INPUT, TaskType.MERGE):
                 outputs[tid] = ()

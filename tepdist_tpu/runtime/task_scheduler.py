@@ -60,6 +60,39 @@ class ScheduleResult:
                 out.extend(tasks)
         return sorted(out, key=lambda t: self.start[t])
 
+    def predicted_timeline(self, dag) -> List[Dict[str, object]]:
+        """Structured per-task predicted schedule keyed by task id — the
+        join surface for telemetry/fidelity.py. Measured spans carry the
+        same ``task`` id (worker_plan.py / executor.py tag them), so
+        predicted-vs-measured is an exact id join, not a name match.
+        ``parents`` rides along so a dumped trace file is a self-contained
+        fidelity input (critical-path walks need the dependency edges)."""
+        out: List[Dict[str, object]] = []
+        for tid in self.order:
+            n = dag.node(tid)
+            out.append({
+                "task": tid,
+                "name": n.name,
+                "kind": n.task_type.value,
+                "stage": n.stage,
+                "micro": n.micro,
+                "worker": n.worker_id,
+                "devices": list(n.device_group),
+                "bytes": float(n.out_bytes),
+                "parents": list(n.parents),
+                "start_us": self.start[tid] * 1e6,
+                "dur_us": (self.finish[tid] - self.start[tid]) * 1e6,
+            })
+        return out
+
+    def critical_path(self, dag) -> List[int]:
+        """Task ids along the simulated critical path (first -> last):
+        from the last-finishing task, walk the latest-finishing
+        predecessor (DAG parent or the preceding occupant of a shared
+        device) back to a source."""
+        from tepdist_tpu.telemetry.fidelity import timeline_critical_path
+        return timeline_critical_path(self.predicted_timeline(dag))
+
     def show_per_device(self, dag, max_tasks: int = 0) -> str:
         """Printable per-device static task lists (reference:
         ShowPerDeviceTaskList, execution_plan.h:187, gated by DEBUG)."""
@@ -73,27 +106,70 @@ class ScheduleResult:
             lines.append(f"device {d}: " + " -> ".join(names))
         return "\n".join(lines)
 
-    def to_chrome_trace(self, dag, path: str) -> None:
+    # Predicted lanes sit at tid >= _SIM_TID_BASE inside each worker's
+    # process group, so they stack NEXT TO the measured thread lanes
+    # (which are small per-thread indices) instead of on top of them.
+    _SIM_TID_BASE = 10000
+
+    def to_chrome_trace(self, dag, path: str,
+                        clock_base_us: float = 0.0,
+                        flow: bool = True) -> None:
         """Export the simulated schedule as a Chrome trace (chrome://tracing
-        / Perfetto). The reference only had dot dumps + per-task logs
-        (SURVEY §5.1); a timeline view is TPU-build surplus."""
+        / Perfetto), aligned with the MEASURED fleet trace
+        (``session.dump_trace()``, telemetry/export.py): same ``pid`` =
+        worker task_index, named ``sim:devN`` lanes, and — when
+        ``clock_base_us`` is set to the measured step's start timestamp —
+        the same clock base, so predicted and measured timelines load
+        side-by-side in one Perfetto view. ``flow=True`` adds flow arrows
+        task->task along the predicted critical path."""
         import json
 
         events = []
+        seen_pids = set()
+        seen_tids = set()
         for tid in self.order:
             n = dag.node(tid)
+            pid = n.worker_id
+            if pid not in seen_pids:
+                seen_pids.add(pid)
+                events.append({"name": "process_name", "ph": "M",
+                               "pid": pid, "tid": 0, "ts": 0, "dur": 0,
+                               "args": {"name": f"worker{pid}"}})
             for d in (n.device_group or (0,)):
+                lane = self._SIM_TID_BASE + d
+                if (pid, lane) not in seen_tids:
+                    seen_tids.add((pid, lane))
+                    events.append({"name": "thread_name", "ph": "M",
+                                   "pid": pid, "tid": lane, "ts": 0,
+                                   "dur": 0,
+                                   "args": {"name": f"sim:dev{d}"}})
                 events.append({
                     "name": n.name,
                     "cat": n.task_type.value,
                     "ph": "X",
-                    "ts": self.start[tid] * 1e6,
+                    "ts": clock_base_us + self.start[tid] * 1e6,
                     "dur": max((self.finish[tid] - self.start[tid]) * 1e6,
                                0.01),
-                    "pid": 0,
-                    "tid": d,
-                    "args": {"stage": n.stage, "micro": n.micro},
+                    "pid": pid,
+                    "tid": lane,
+                    "args": {"task": tid, "stage": n.stage,
+                             "micro": n.micro, "predicted": True},
                 })
+        if flow:
+            cp = self.critical_path(dag)
+            for i, (a, b) in enumerate(zip(cp, cp[1:])):
+                na, nb = dag.node(a), dag.node(b)
+                lane_a = self._SIM_TID_BASE + (na.device_group or (0,))[0]
+                lane_b = self._SIM_TID_BASE + (nb.device_group or (0,))[0]
+                common = {"name": "critical_path", "cat": "sim",
+                          "id": i + 1, "dur": 0}
+                events.append({**common, "ph": "s", "pid": na.worker_id,
+                               "tid": lane_a,
+                               "ts": clock_base_us
+                               + self.finish[a] * 1e6 - 0.005})
+                events.append({**common, "ph": "f", "bp": "e",
+                               "pid": nb.worker_id, "tid": lane_b,
+                               "ts": clock_base_us + self.start[b] * 1e6})
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)
@@ -128,9 +204,18 @@ class TaskScheduler:
                 and self._async_transport()):
             # The HOST dispatch floor is paid regardless — only the WIRE
             # time collapses to the launch alpha.
-            oh = ServiceEnv.get().task_overhead_us * 1e-6
-            return oh + min(self._device_time(n), ALPHA_S)
+            return self._host_floor_s() + min(self._device_time(n), ALPHA_S)
         return self.task_time(n)
+
+    def _host_floor_s(self) -> float:
+        """Per-task host dispatch floor, seconds. A calibration profile
+        (TEPDIST_CALIB_PROFILE, telemetry/calibrate.py) carries the
+        MEASURED floor and beats the TASK_OVERHEAD_US default."""
+        from tepdist_tpu.telemetry.calibrate import active_profile
+        prof = active_profile()
+        if prof is not None and prof.task_overhead_us > 0:
+            return prof.task_overhead_us * 1e-6
+        return ServiceEnv.get().task_overhead_us * 1e-6
 
     def _async_transport(self) -> bool:
         mode = ServiceEnv.get().async_transport.lower()
@@ -147,14 +232,14 @@ class TaskScheduler:
         return self._async_auto
 
     def task_time(self, n: TaskNode) -> float:
-        # Per-task host dispatch floor (TASK_OVERHEAD_US): every task is
-        # a host-side dispatch (jit call / device_put / store op). 0 by
-        # default — on TPU the host work overlaps long device compute —
-        # but on the CPU mesh it's the measured per-task floor, and
-        # pricing it is what keeps pipeline candidates honest against
-        # single-jit SPMD rivals in the measured-validation contract.
-        oh = ServiceEnv.get().task_overhead_us * 1e-6
-        return oh + self._device_time(n)
+        # Per-task host dispatch floor (TASK_OVERHEAD_US, or a fitted
+        # calibration profile): every task is a host-side dispatch (jit
+        # call / device_put / store op). 0 by default — on TPU the host
+        # work overlaps long device compute — but on the CPU mesh it's
+        # the measured per-task floor, and pricing it is what keeps
+        # pipeline candidates honest against single-jit SPMD rivals in
+        # the measured-validation contract.
+        return self._host_floor_s() + self._device_time(n)
 
     def _device_time(self, n: TaskNode) -> float:
         if n.task_type == TaskType.COMPUTE:

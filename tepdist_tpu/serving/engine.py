@@ -90,6 +90,8 @@ class ServeRequest:
     kd: Any = None                   # raw uint32 RNG key data (non-greedy)
     pos: int = 0                     # next cache write position
     ttft_span: Any = None
+    decode_ms: float = 0.0           # summed batched-decode step time
+    decode_steps: int = 0
 
     def result(self) -> Dict[str, Any]:
         out = {
@@ -104,6 +106,12 @@ class ServeRequest:
             out["ttft_ms"] = round((self.t_first - self.t_submit) * 1e3, 3)
         if self.t_done is not None:
             out["total_ms"] = round((self.t_done - self.t_submit) * 1e3, 3)
+        if self.decode_steps:
+            # Per-request attribution: how much of total_ms was actual
+            # batched decode compute vs queueing/scheduling (the serving
+            # analogue of the per-step fidelity attribution).
+            out["decode_ms"] = round(self.decode_ms, 3)
+            out["decode_steps"] = self.decode_steps
         return out
 
 
@@ -381,6 +389,8 @@ class ServingEngine:
                     continue          # cancelled mid-step: drop the token
                 r.tokens.append(tok_i)
                 r.pos += 1
+                r.decode_ms += step_ms
+                r.decode_steps += 1
                 m.counter("serve_tokens").inc()
                 m.histogram("serve_token_ms").observe(step_ms)
                 if len(r.tokens) >= r.max_new_tokens:

@@ -33,5 +33,8 @@ from tepdist_tpu.telemetry.export import (  # noqa: F401
     build_trace,
     dump_merged_trace,
     to_chrome_events,
+    to_prometheus,
     write_trace,
 )
+from tepdist_tpu.telemetry import calibrate  # noqa: F401
+from tepdist_tpu.telemetry import fidelity  # noqa: F401

@@ -55,23 +55,39 @@ def to_chrome_events(spans: Iterable[Dict[str, Any]], pid: int,
     return events
 
 
-def build_trace(payloads: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+def build_trace(payloads: Iterable[Dict[str, Any]],
+                extra_metadata: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Any]:
     """Merge per-process telemetry payloads into one trace object.
 
     Each payload: ``{"pid": int, "label": str, "spans": [...],
-    "offset_us": float, "metrics": snapshot-or-None}``.
+    "offset_us": float, "metrics": snapshot-or-None,
+    "spans_dropped": int}``. ``extra_metadata`` entries land under the
+    trace's ``metadata`` key (e.g. the simulator's predicted timeline so a
+    trace file is a self-contained fidelity-report input).
     """
     events: List[Dict[str, Any]] = []
     snaps: List[Dict[str, Any]] = []
+    dropped: Dict[str, int] = {}
     for p in payloads:
         events.extend(to_chrome_events(
             p.get("spans", ()), pid=p["pid"],
             offset_us=p.get("offset_us", 0.0), label=p.get("label")))
         if p.get("metrics"):
             snaps.append(p["metrics"])
+        if p.get("spans_dropped"):
+            dropped[p.get("label") or str(p["pid"])] = int(
+                p["spans_dropped"])
     trace: Dict[str, Any] = {"traceEvents": events, "displayTimeUnit": "ms"}
+    meta: Dict[str, Any] = {}
     if snaps:
-        trace["metadata"] = {"metrics": MetricsRegistry.merge(snaps)}
+        meta["metrics"] = MetricsRegistry.merge(snaps)
+    if dropped:
+        meta["spans_dropped"] = dropped
+    if extra_metadata:
+        meta.update(extra_metadata)
+    if meta:
+        trace["metadata"] = meta
     return trace
 
 
@@ -105,22 +121,27 @@ def worker_payload(client, clear: bool = False) -> Dict[str, Any]:
     return {"pid": ti, "label": f"worker{ti}",
             "spans": h.get("spans", ()),
             "offset_us": h.get("offset_us", 0.0),
-            "metrics": h.get("metrics")}
+            "metrics": h.get("metrics"),
+            "spans_dropped": int(h.get("spans_dropped", 0))}
 
 
 def local_payload(label: str = "client") -> Dict[str, Any]:
     """This process's own tracer/registry (the master/client timeline)."""
     from tepdist_tpu.telemetry import metrics as _metrics
     from tepdist_tpu.telemetry import trace as _trace
+    t = _trace.tracer()
     return {"pid": CLIENT_PID, "label": label,
-            "spans": _trace.tracer().snapshot(),
+            "spans": t.snapshot(),
             "offset_us": 0.0,
-            "metrics": _metrics().snapshot()}
+            "metrics": _metrics().snapshot(),
+            "spans_dropped": t.dropped}
 
 
 def dump_merged_trace(clients, path: Optional[str] = None,
                       name: str = "trace", include_local: bool = True,
-                      clear: bool = False) -> Optional[str]:
+                      clear: bool = False,
+                      extra_metadata: Optional[Dict[str, Any]] = None
+                      ) -> Optional[str]:
     """Pull every worker's telemetry, clock-align, and write one merged
     Perfetto-loadable trace. An unreachable worker is skipped (its track
     is simply absent) — dumping diagnostics never breaks the session."""
@@ -133,4 +154,58 @@ def dump_merged_trace(clients, path: Optional[str] = None,
         except Exception as e:  # noqa: BLE001 — best-effort per worker
             log.warning("GetTelemetry failed for %s: %r",
                         getattr(getattr(c, "stub", None), "address", "?"), e)
-    return write_trace(build_trace(payloads), path=path, name=name)
+    lossy = {p.get("label") or str(p["pid"]): p["spans_dropped"]
+             for p in payloads if p.get("spans_dropped")}
+    if lossy:
+        log.warning(
+            "merged trace is LOSSY: span ring overflowed (%s dropped); "
+            "missing spans read as idle time — raise "
+            "TEPDIST_TRACE_CAPACITY or dump more often",
+            ", ".join(f"{k}={v}" for k, v in sorted(lossy.items())))
+    return write_trace(build_trace(payloads, extra_metadata=extra_metadata),
+                       path=path, name=name)
+
+
+# -- Prometheus text format -------------------------------------------------
+
+# ":" is excluded: legal in Prometheus names but reserved for recording
+# rules — exporters are expected to sanitize it away.
+_PROM_OK = set("abcdefghijklmnopqrstuvwxyz"
+               "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_")
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(ch if ch in _PROM_OK else "_" for ch in name)
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return "tepdist_" + out
+
+
+def to_prometheus(snapshot: Dict[str, Any]) -> str:
+    """Render a metrics snapshot (``MetricsRegistry.snapshot()`` or a
+    ``merge()`` of many) in the Prometheus text exposition format, so the
+    fleet can be scraped without Perfetto: counters as ``counter``,
+    gauges as ``gauge``, histograms as summaries (reservoir p50/p95/p99
+    quantiles + ``_sum``/``_count``)."""
+    lines: List[str] = []
+    for name, v in sorted((snapshot.get("counters") or {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {v}")
+    for name, v in sorted((snapshot.get("gauges") or {}).items()):
+        if v is None:
+            continue
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {v}")
+    for name, h in sorted((snapshot.get("histograms") or {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} summary")
+        for q in ("0.5", "0.95", "0.99"):
+            key = {"0.5": "p50", "0.95": "p95", "0.99": "p99"}[q]
+            val = h.get(key)
+            if val is not None:
+                lines.append(f'{pn}{{quantile="{q}"}} {val}')
+        lines.append(f"{pn}_sum {h.get('sum', 0.0)}")
+        lines.append(f"{pn}_count {h.get('count', 0)}")
+    return "\n".join(lines) + ("\n" if lines else "")

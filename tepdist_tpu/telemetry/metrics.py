@@ -15,8 +15,23 @@ conservative read for the RTT/lag gauges this repo records).
 
 from __future__ import annotations
 
+import math
+import random
 import threading
-from typing import Any, Dict, Iterable, Optional
+from typing import Any, Dict, Iterable, List, Optional
+
+
+def _quantile(sorted_vals: List[float], q: float) -> Optional[float]:
+    """Linear-interpolated quantile over an already-sorted sample."""
+    if not sorted_vals:
+        return None
+    idx = q * (len(sorted_vals) - 1)
+    lo = int(math.floor(idx))
+    hi = int(math.ceil(idx))
+    if lo == hi:
+        return sorted_vals[lo]
+    frac = idx - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
 
 
 class Counter:
@@ -46,10 +61,17 @@ class Gauge:
 
 
 class Histogram:
-    """Streaming count/sum/min/max — enough for latency attribution
-    without committing to a bucket layout on the wire."""
+    """Streaming count/sum/min/max plus a fixed-size uniform reservoir
+    (Vitter's Algorithm R) so ``to_dict()`` can report p50/p95/p99 SLO
+    percentiles without committing to a bucket layout on the wire. The
+    reservoir is exact below RESERVOIR_SIZE observations and an unbiased
+    uniform sample above it; the RNG is seeded per-histogram so snapshots
+    are deterministic under a fixed observation sequence."""
 
-    __slots__ = ("count", "sum", "min", "max", "_lock")
+    RESERVOIR_SIZE = 256
+
+    __slots__ = ("count", "sum", "min", "max", "_lock", "_reservoir",
+                 "_rng")
 
     def __init__(self):
         self.count = 0
@@ -57,6 +79,8 @@ class Histogram:
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self._lock = threading.Lock()
+        self._reservoir: List[float] = []
+        self._rng = random.Random(0x7e9d)
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -67,11 +91,23 @@ class Histogram:
                 self.min = v
             if self.max is None or v > self.max:
                 self.max = v
+            if len(self._reservoir) < self.RESERVOIR_SIZE:
+                self._reservoir.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self.RESERVOIR_SIZE:
+                    self._reservoir[j] = v
 
     def to_dict(self) -> Dict[str, Any]:
         mean = self.sum / self.count if self.count else 0.0
+        with self._lock:
+            sample = sorted(self._reservoir)
         return {"count": self.count, "sum": self.sum, "mean": mean,
-                "min": self.min, "max": self.max}
+                "min": self.min, "max": self.max,
+                "p50": _quantile(sample, 0.50),
+                "p95": _quantile(sample, 0.95),
+                "p99": _quantile(sample, 0.99),
+                "reservoir": sample}
 
 
 class MetricsRegistry:
@@ -147,6 +183,21 @@ class MetricsRegistry:
                     cur[key] = fn(vals) if vals else None
                 cur["mean"] = (cur["sum"] / cur["count"]
                                if cur["count"] else 0.0)
+                # Pool the uniform reservoirs, recompute the percentiles
+                # over the pooled sample, then thin back to RESERVOIR_SIZE
+                # by even stride (deterministic, distribution-preserving)
+                # so repeated merges don't grow the wire payload.
+                pooled = sorted(list(cur.get("reservoir", ()))
+                                + list(h.get("reservoir", ())))
+                if pooled:
+                    cur["p50"] = _quantile(pooled, 0.50)
+                    cur["p95"] = _quantile(pooled, 0.95)
+                    cur["p99"] = _quantile(pooled, 0.99)
+                    cap = Histogram.RESERVOIR_SIZE
+                    if len(pooled) > cap:
+                        step = len(pooled) / cap
+                        pooled = [pooled[int(i * step)] for i in range(cap)]
+                    cur["reservoir"] = pooled
         return {"counters": counters, "gauges": gauges, "histograms": hists}
 
 

@@ -116,11 +116,20 @@ class Tracer:
         self.capacity = capacity
         self._buf: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
+        # How many spans the ring has silently overwritten since the last
+        # drain — a lossy merged trace is misleading (missing tasks look
+        # like idle time), so exporters surface this count and warn.
+        # Best-effort under the GIL: a lost increment under a race costs
+        # at most an off-by-one on a diagnostic counter.
+        self.dropped = 0
 
     def _record(self, sp: Span) -> None:
         th = threading.current_thread()
+        buf = self._buf
+        if len(buf) >= self.capacity:
+            self.dropped += 1
         # deque.append is GIL-atomic; the dict is the export-ready record.
-        self._buf.append({
+        buf.append({
             "name": sp.name,
             "cat": sp.cat,
             "ts": sp.ts_us,
@@ -130,16 +139,20 @@ class Tracer:
         })
 
     def snapshot(self, clear: bool = False) -> List[Dict[str, Any]]:
-        """Copy out the buffered spans (optionally draining the ring)."""
+        """Copy out the buffered spans (optionally draining the ring).
+        Draining also resets ``dropped`` — the count describes the spans
+        being handed out, not all of history."""
         with self._lock:
             out = list(self._buf)
             if clear:
                 self._buf.clear()
+                self.dropped = 0
         return out
 
     def clear(self) -> None:
         with self._lock:
             self._buf.clear()
+            self.dropped = 0
 
     def __len__(self) -> int:
         return len(self._buf)

@@ -1,0 +1,380 @@
+"""Schedule-fidelity tests: predicted-vs-measured join, drift math,
+critical path, attribution, and evaluator auto-calibration.
+
+Synthetic timelines pin the math; the two-worker in-proc fleet fixture
+proves the end-to-end contract the CI gate (scripts/fidelity_smoke.sh)
+relies on: every dispatched predicted task joins a measured span, and a
+profile fitted from that join makes the simulator strictly more accurate
+on the very fleet it was fitted on.
+"""
+
+import json
+
+import pytest
+
+from tepdist_tpu.telemetry import calibrate, fidelity
+from tepdist_tpu.telemetry import trace as trace_mod
+
+
+# ---------------------------------------------------------------------------
+# synthetic timelines: the math
+
+
+def _pred(task, kind, start, dur, devices=((0, 0),), parents=(),
+          worker=0, bytes_=None):
+    return {"task": task, "name": f"t{task}", "kind": kind,
+            "stage": 0, "micro": 0, "worker": worker,
+            "devices": list(devices), "bytes": bytes_,
+            "parents": list(parents), "start_us": float(start),
+            "dur_us": float(dur)}
+
+
+def _span(task, ts, dur, cat="compute", step=0, worker=0, **extra):
+    args = {"task": task, "step": step, "worker": worker}
+    args.update(extra)
+    return {"name": f"t{task}", "cat": cat, "ts": float(ts),
+            "dur": float(dur), "tid": "w", "args": args}
+
+
+def test_join_exact_orphans_and_skips():
+    predicted = [
+        _pred(1, "compute", 0, 10),
+        _pred(2, "send", 10, 5, bytes_=128),
+        _pred(3, "split", 0, 0, devices=()),   # bookkeeping: skipped
+        _pred(4, "compute", 15, 10),           # never measured: orphan
+    ]
+    measured = fidelity.measured_task_spans([
+        _span(1, 100, 30),
+        _span(2, 130, 10, cat="send"),
+        _span(9, 150, 1),                      # not in the schedule
+    ])
+    j = fidelity.join_timelines(predicted, measured)
+    assert [r["task"] for r in j.matched] == [1, 2]
+    assert j.orphan_predicted == [4]
+    assert j.orphan_measured == [9]
+    assert j.skipped == [3]
+    assert j.join_fraction == pytest.approx(2 / 3)
+    r1 = j.matched[0]
+    assert r1["measured_us"] == 30.0
+    assert r1["drift_us"] == pytest.approx(20.0)
+    assert r1["ratio"] == pytest.approx(3.0)
+
+
+def test_join_means_across_steps_and_bytes_fallback():
+    predicted = [_pred(1, "recv", 0, 4, bytes_=None)]
+    # Two steps: the join wants the typical cost, so the mean.
+    measured = fidelity.measured_task_spans([
+        _span(1, 100, 10, cat="recv", step=0, bytes=256),
+        _span(1, 500, 30, cat="recv", step=1, bytes=256),
+    ])
+    j = fidelity.join_timelines(predicted, measured)
+    (r,) = j.matched
+    assert r["measured_us"] == pytest.approx(20.0)
+    assert r["n_measured"] == 2
+    assert r["measured_ts_us"] == 100.0      # earliest occurrence
+    assert r["bytes"] == 256                 # filled from the span
+
+
+def test_measured_spans_step_filter_and_chrome_events():
+    raw = [_span(1, 0, 5, step=0), _span(1, 50, 7, step=1)]
+    assert fidelity.steps_present(raw) == [0, 1]
+    only1 = fidelity.measured_task_spans(raw, step=1)
+    assert [m["dur_us"] for m in only1] == [7.0]
+    # Merged chrome-trace events (ph="X") parse identically; metadata
+    # events (ph="M") and flow events must be ignored.
+    chrome = [dict(raw[0], ph="X", pid=0),
+              {"ph": "M", "name": "process_name", "pid": 0, "ts": 0,
+               "dur": 0, "args": {"name": "w0"}},
+              {"ph": "s", "name": "critical_path", "ts": 1, "dur": 0,
+               "id": 1, "pid": 0, "tid": 0, "cat": "sim"}]
+    ms = fidelity.measured_task_spans(chrome)
+    assert len(ms) == 1 and ms[0]["task"] == 1
+
+
+def test_drift_by_kind_aggregates():
+    matched = [
+        dict(_pred(1, "compute", 0, 1000), measured_us=3000.0),
+        dict(_pred(2, "compute", 0, 1000), measured_us=5000.0),
+        dict(_pred(3, "send", 0, 2000), measured_us=2000.0),
+    ]
+    agg = fidelity.drift_by_kind(matched)
+    c = agg["compute"]
+    assert c["n"] == 2
+    assert c["predicted_ms"] == pytest.approx(2.0)
+    assert c["measured_ms"] == pytest.approx(8.0)
+    assert c["drift_ms"] == pytest.approx(6.0)
+    assert c["ratio"] == pytest.approx(4.0)
+    assert agg["send"]["ratio"] == pytest.approx(1.0)
+
+
+def test_critical_path_follows_latest_predecessor():
+    # 1 -> 2 -> 4 and 1 -> 3 -> 4; the 3-branch finishes later, so the
+    # path must run through 3, not 2.
+    recs = [
+        _pred(1, "compute", 0, 10),
+        _pred(2, "compute", 10, 5, parents=[1]),
+        _pred(3, "send", 10, 30, parents=[1], devices=[(0, 1)]),
+        _pred(4, "compute", 40, 10, parents=[2, 3]),
+    ]
+    assert fidelity.timeline_critical_path(recs) == [1, 3, 4]
+
+
+def test_critical_path_includes_device_serialization():
+    # No DAG edge between 1 and 2, but they share a device: waiting for
+    # the previous occupant is attribution too.
+    recs = [
+        _pred(1, "compute", 0, 50, devices=[(0, 0)]),
+        _pred(2, "compute", 50, 10, devices=[(0, 0)]),
+    ]
+    assert fidelity.timeline_critical_path(recs) == [1, 2]
+
+
+def test_attribution_priority_partition_and_idle():
+    us = 1000.0
+    step_env = {"name": "run_step", "cat": "step", "ts": 0.0,
+                "dur": 100 * us, "tid": "w",
+                "args": {"step": 0, "worker": 0}}
+    events = [
+        step_env,
+        _span(1, 0, 40 * us, cat="compute"),
+        _span(2, 40 * us, 20 * us, cat="send"),
+        # serde nested INSIDE the send: owns its overlap (priority).
+        {"name": "serde:encode", "cat": "serde", "ts": 45 * us,
+         "dur": 5 * us, "tid": "w", "args": {"worker": 0, "step": 0}},
+    ]
+    att = fidelity.attribution(events, step=0)
+    a = att["0"]
+    assert a["window_ms"] == pytest.approx(100.0)
+    assert a["compute_ms"] == pytest.approx(40.0)
+    assert a["transfer_ms"] == pytest.approx(15.0)   # 20 - 5 owned by serde
+    assert a["host_serde_ms"] == pytest.approx(5.0)
+    assert a["collective_ms"] == 0.0
+    assert a["idle_ms"] == pytest.approx(40.0)
+
+
+def test_attribution_clamps_untagged_spans_to_step_window():
+    us = 1000.0
+    events = [
+        {"name": "run_step", "cat": "step", "ts": 100 * us, "dur": 50 * us,
+         "tid": "w", "args": {"step": 0, "worker": 0}},
+        # Untagged host serde: one span inside the step window, one far
+        # outside it (a different step's client work) — the outside one
+        # must not stretch the untagged lane's window.
+        {"name": "serde:encode", "cat": "serde", "ts": 110 * us,
+         "dur": 5 * us, "tid": "m", "args": {}},
+        {"name": "serde:encode", "cat": "serde", "ts": 900 * us,
+         "dur": 5 * us, "tid": "m", "args": {}},
+    ]
+    att = fidelity.attribution(events, step=0)
+    lane = att["None"]
+    assert lane["host_serde_ms"] == pytest.approx(5.0)
+    assert lane["window_ms"] <= 50.0
+
+
+# ---------------------------------------------------------------------------
+# calibration: fit math + persistence + resolution
+
+
+def _cal_rows():
+    # Measured = 100 us host floor + 3x predicted device time (compute)
+    # + bytes / 1e8 B/s (transfers). Six near-pure-dispatch rows pin the
+    # p10 at the floor; the fit must then recover scale=3 and bw=1e8.
+    rows = [dict(_pred(90 + i, "input", 0, 50.0), measured_us=100.0)
+            for i in range(6)]
+    for i, dev_us in enumerate((1000.0, 2000.0, 4000.0)):
+        rows.append(dict(_pred(i, "compute", 0, dev_us + 50.0),
+                         measured_us=100.0 + 3.0 * dev_us))
+    for i, nbytes in enumerate((1 << 20, 2 << 20)):
+        rows.append(dict(_pred(10 + i, "send", 0, 500.0, bytes_=nbytes),
+                         measured_us=100.0 + nbytes / 1e8 * 1e6))
+    return rows
+
+
+def test_fit_profile_recovers_planted_constants():
+    prof = calibrate.fit_profile(_cal_rows(), base_overhead_us=50.0)
+    assert prof.task_overhead_us == pytest.approx(100.0, rel=0.01)
+    assert prof.compute_scale == pytest.approx(3.0, rel=0.02)
+    assert prof.transfer_bytes_per_s == pytest.approx(1e8, rel=0.02)
+    assert prof.hbm_scale == -1.0        # no ga/apply rows: unfitted
+    assert prof.ar_bytes_per_s == -1.0
+    assert prof.meta["n_rows"] == 11
+    assert prof.meta["rows_per_kind"] == {"compute": 3, "input": 6,
+                                          "send": 2}
+
+
+def test_fit_profile_empty_and_degenerate():
+    assert calibrate.fit_profile([]).meta["n_rows"] == 0
+    # All-zero predicted durations: slope must be -1, not a crash.
+    rows = [dict(_pred(1, "compute", 0, 0.0), measured_us=10.0)]
+    prof = calibrate.fit_profile(rows)
+    assert prof.task_overhead_us > 0
+
+
+def test_profile_json_round_trip(tmp_path):
+    prof = calibrate.CalibrationProfile(
+        task_overhead_us=42.0, compute_scale=3.5,
+        transfer_bytes_per_s=2.5e8, meta={"n_rows": 7})
+    p = str(tmp_path / "sub" / "calib.json")
+    prof.save(p)
+    raw = json.load(open(p))
+    raw["unknown_future_field"] = 1      # forward-compat: ignored
+    json.dump(raw, open(p, "w"))
+    back = calibrate.CalibrationProfile.load(p)
+    assert back == prof
+
+
+def test_active_profile_override_and_env(tmp_path, monkeypatch):
+    from tepdist_tpu.core.service_env import ServiceEnv
+
+    prof = calibrate.CalibrationProfile(task_overhead_us=7.0)
+    p = prof.save(str(tmp_path / "c.json"))
+    try:
+        # 1. explicit override wins
+        calibrate.set_active(prof)
+        assert calibrate.active_profile() is prof
+        # 2. set_active(None) forces UNcalibrated even with the env set
+        monkeypatch.setenv("TEPDIST_CALIB_PROFILE", p)
+        ServiceEnv.reset()
+        calibrate.invalidate()
+        calibrate.set_active(None)
+        assert calibrate.active_profile() is None
+        # 3. clear_active(): back to env-driven resolution
+        calibrate.clear_active()
+        env_prof = calibrate.active_profile()
+        assert env_prof is not None
+        assert env_prof.task_overhead_us == 7.0
+        # 4. unreadable path: warn + default model, not an exception
+        monkeypatch.setenv("TEPDIST_CALIB_PROFILE",
+                           str(tmp_path / "missing.json"))
+        ServiceEnv.reset()
+        calibrate.invalidate()
+        assert calibrate.active_profile() is None
+    finally:
+        calibrate.clear_active()
+        monkeypatch.delenv("TEPDIST_CALIB_PROFILE", raising=False)
+        ServiceEnv.reset()
+        calibrate.invalidate()
+
+
+def test_profile_changes_scheduler_and_perfutils_costs():
+    from tepdist_tpu.runtime.task_scheduler import TaskScheduler
+
+    prof = calibrate.CalibrationProfile(
+        task_overhead_us=1e4, compute_scale=10.0,
+        transfer_bytes_per_s=1e6, ar_bytes_per_s=1e6, hbm_scale=10.0)
+    sched = TaskScheduler.__new__(TaskScheduler)  # _host_floor_s is
+    base_floor = sched._host_floor_s()            # instance-state-free
+    calibrate.set_active(prof)
+    try:
+        floor = sched._host_floor_s()
+        assert floor == pytest.approx(1e-2)
+        assert floor > base_floor
+    finally:
+        calibrate.clear_active()
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end contract: two-worker in-proc fleet
+
+
+@pytest.fixture(scope="module")
+def fleet_report():
+    """One fixture run shared by the join/calibration tests (the fleet
+    spin-up dominates the cost)."""
+    import sys
+    sys.path.insert(0, __file__.rsplit("/", 2)[0] + "/tools")
+    import fidelity_report as fr
+
+    prev_enabled = trace_mod.tracer().enabled
+    try:
+        report = fr.run_fixture(steps=2)
+    finally:
+        trace_mod.configure(enabled=prev_enabled)
+    return report
+
+
+def test_fleet_join_is_exact(fleet_report):
+    j = fleet_report["join"]
+    assert j["fraction"] == 1.0, j
+    assert j["orphan_predicted"] == []
+    assert j["orphan_measured"] == []
+    assert j["matched"] > 0
+    # Every dispatched kind shows up in the drift table.
+    kinds = set(fleet_report["per_kind"])
+    assert {"compute", "send", "recv"} <= kinds
+    # Both workers appear in the attribution.
+    assert {"0", "1"} <= set(fleet_report["attribution"])
+    assert fleet_report["measured_critical_path"]
+
+
+def test_fleet_calibration_strictly_reduces_error(fleet_report, tmp_path,
+                                                  monkeypatch):
+    from tepdist_tpu.core.service_env import ServiceEnv
+    from tepdist_tpu.runtime.task_scheduler import TaskScheduler
+
+    measured_ms = fleet_report["measured_step_ms"]
+    uncal_ms = fleet_report["uncalibrated_makespan_ms"]
+    prof = calibrate.fit_profile(
+        fleet_report["matched"],
+        base_overhead_us=ServiceEnv.get().task_overhead_us)
+
+    # Round-trip through disk + the env knob — the exact production path.
+    p = prof.save(str(tmp_path / "calib.json"))
+    monkeypatch.setenv("TEPDIST_CALIB_PROFILE", p)
+    ServiceEnv.reset()
+    calibrate.invalidate()
+    try:
+        loaded = calibrate.active_profile()
+        assert loaded == prof
+        cal_ms = TaskScheduler(
+            fleet_report["_dag"]).schedule().makespan * 1e3
+    finally:
+        monkeypatch.delenv("TEPDIST_CALIB_PROFILE", raising=False)
+        ServiceEnv.reset()
+        calibrate.invalidate()
+
+    assert abs(cal_ms - measured_ms) < abs(uncal_ms - measured_ms), (
+        f"calibrated {cal_ms:.3f} ms vs uncalibrated {uncal_ms:.3f} ms "
+        f"(measured {measured_ms:.3f} ms)")
+
+
+def test_predicted_timeline_and_chrome_alignment(fleet_report, tmp_path):
+    from tepdist_tpu.runtime.task_scheduler import TaskScheduler
+
+    dag = fleet_report["_dag"]
+    sched = TaskScheduler(dag).schedule()
+    rows = sched.predicted_timeline(dag)
+    assert {r["task"] for r in rows} == {n.id for n in dag.nodes}
+    for r in rows:
+        assert r["start_us"] >= 0 and r["dur_us"] >= 0
+
+    path = str(tmp_path / "sim.json")
+    sched.to_chrome_trace(dag, path, clock_base_us=123.0)
+    trace = json.load(open(path))
+    evs = trace["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    # Simulated lanes ride the SAME pids as measured worker processes,
+    # offset thread ids, and the supplied clock base.
+    assert {e["pid"] for e in xs} <= {n.worker_id for n in dag.nodes}
+    assert all(e["tid"] >= sched._SIM_TID_BASE for e in xs)
+    assert min(e["ts"] for e in xs) >= 123.0
+    assert all(e["args"].get("predicted") for e in xs)
+    flows = [e for e in evs if e["ph"] in ("s", "f")]
+    assert flows, "predicted critical path must emit flow events"
+    for e in evs:  # Perfetto shape: every event carries ts and dur
+        assert "ts" in e and "dur" in e
+
+
+def test_disabled_tracer_serde_is_free():
+    from tepdist_tpu.rpc import protocol
+    from tepdist_tpu.telemetry.trace import _NULL_SPAN, Tracer
+
+    prev = trace_mod._TRACER
+    trace_mod._TRACER = t = Tracer(capacity=16, enabled=False)
+    try:
+        assert trace_mod.span("serde:encode", cat="serde") is _NULL_SPAN
+        meta, blob = protocol.encode_literal([1.0, 2.0])
+        protocol.decode_literal(meta, blob)
+        assert len(t) == 0          # no spans recorded when disabled
+    finally:
+        trace_mod._TRACER = prev

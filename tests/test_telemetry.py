@@ -261,3 +261,124 @@ def test_trace_summary_busy_and_bubble(tmp_path):
     with pytest.raises(ValueError):
         json.dump({"nope": 1}, open(str(tmp_path / "bad.json"), "w"))
         trace_summary.load_trace(str(tmp_path / "bad.json"))
+
+
+# ---------------------------------------------------------------------------
+# histogram reservoir percentiles + Prometheus exposition
+
+
+def test_histogram_reservoir_percentiles():
+    from tepdist_tpu.telemetry.metrics import Histogram
+
+    h = Histogram()
+    for v in range(1, 101):  # below RESERVOIR_SIZE: sample is exact
+        h.observe(float(v))
+    d = h.to_dict()
+    assert d["p50"] == pytest.approx(50.5)
+    assert d["p95"] == pytest.approx(95.05)
+    assert d["p99"] == pytest.approx(99.01)
+    assert len(d["reservoir"]) == 100
+    json.dumps(d)  # travels in the GetTelemetry header
+
+
+def test_histogram_reservoir_caps_and_stays_deterministic():
+    from tepdist_tpu.telemetry.metrics import Histogram
+
+    def fill():
+        h = Histogram()
+        for v in range(10_000):
+            h.observe(float(v))
+        return h.to_dict()
+
+    a, b = fill(), fill()
+    assert len(a["reservoir"]) == Histogram.RESERVOIR_SIZE
+    assert a == b  # seeded RNG: snapshots are reproducible
+    # A uniform sample of 0..9999 must put p50 near the middle.
+    assert 3000 < a["p50"] < 7000
+
+
+def test_merge_pools_reservoirs_and_recomputes_percentiles():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    for v in range(1, 51):
+        a.histogram("lat").observe(float(v))       # 1..50
+    for v in range(51, 101):
+        b.histogram("lat").observe(float(v))       # 51..100
+    m = MetricsRegistry.merge([a.snapshot(), b.snapshot()])
+    h = m["histograms"]["lat"]
+    assert h["count"] == 100
+    # Percentiles span BOTH workers, not either one alone.
+    assert h["p50"] == pytest.approx(50.5)
+    assert h["p95"] == pytest.approx(95.05)
+    assert len(h["reservoir"]) == 100
+
+
+def test_merge_thins_pooled_reservoir_to_cap():
+    from tepdist_tpu.telemetry.metrics import Histogram
+
+    regs = []
+    for w in range(4):
+        r = MetricsRegistry()
+        for v in range(200):
+            r.histogram("lat").observe(float(w * 200 + v))
+        regs.append(r.snapshot())
+    m = MetricsRegistry.merge(regs)
+    h = m["histograms"]["lat"]
+    assert h["count"] == 800
+    # Repeated merges must not grow the wire payload past the cap.
+    assert len(h["reservoir"]) == Histogram.RESERVOIR_SIZE
+    assert h["reservoir"] == sorted(h["reservoir"])
+
+
+def test_to_prometheus_exposition():
+    from tepdist_tpu.telemetry.export import to_prometheus
+
+    r = MetricsRegistry()
+    r.counter("worker_steps").inc(5)
+    r.counter("rpc_ms:RunStep")  # name needs sanitizing
+    r.gauge("serve_queue_depth").set(3.0)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        r.histogram("serve_ttft_ms").observe(v)
+    text = to_prometheus(r.snapshot())
+    assert "# TYPE tepdist_worker_steps counter" in text
+    assert "tepdist_worker_steps 5" in text
+    assert "tepdist_rpc_ms_RunStep 0" in text  # ':' sanitized
+    assert "# TYPE tepdist_serve_queue_depth gauge" in text
+    assert "tepdist_serve_queue_depth 3.0" in text
+    assert "# TYPE tepdist_serve_ttft_ms summary" in text
+    assert 'tepdist_serve_ttft_ms{quantile="0.5"}' in text
+    assert 'tepdist_serve_ttft_ms{quantile="0.99"}' in text
+    assert "tepdist_serve_ttft_ms_sum 10.0" in text
+    assert "tepdist_serve_ttft_ms_count 4" in text
+    assert text.endswith("\n")
+
+
+# ---------------------------------------------------------------------------
+# spans_dropped: the ring-overflow truth-teller
+
+
+def test_tracer_counts_drops_and_resets():
+    t = Tracer(capacity=4, enabled=True)
+    for i in range(10):
+        with Span(t, f"s{i}", "misc", {}):
+            pass
+    assert t.dropped == 6
+    t.snapshot(clear=False)
+    assert t.dropped == 6         # non-draining read keeps the count
+    t.snapshot(clear=True)
+    assert t.dropped == 0         # drain resets: drops are per-window
+    with Span(t, "s", "misc", {}):
+        pass
+    t.clear()
+    assert t.dropped == 0 and len(t) == 0
+
+
+def test_build_trace_surfaces_spans_dropped():
+    trace = build_trace([
+        {"pid": 0, "label": "worker0", "spans": _fake_spans(0.0),
+         "spans_dropped": 3},
+        {"pid": 1, "label": "worker1", "spans": _fake_spans(10.0),
+         "spans_dropped": 0},
+    ])
+    assert trace["metadata"]["spans_dropped"] == {"worker0": 3}
+    lossless = build_trace([{"pid": 0, "spans": _fake_spans(0.0)}])
+    assert "spans_dropped" not in lossless.get("metadata", {})

@@ -1,0 +1,258 @@
+"""Predicted-vs-measured schedule fidelity report.
+
+Joins the cost-model simulator's predicted per-task timeline
+(``ScheduleResult.predicted_timeline()``) with measured task spans and
+prints: a per-kind drift table, the measured critical path (top-N
+tasks), per-worker wall-time attribution (compute / collective /
+transfer / host-serde / idle), and a fitted calibration profile
+(telemetry/calibrate.py) with predicted step times before/after
+calibration.
+
+Two modes:
+
+* default — spin the two-worker in-proc fleet fixture (the same MLP
+  pipeline the fault/chaos suites use), run ``--steps`` training steps
+  with tracing on, and report on the last step.
+* ``--trace FILE`` — offline: read a merged trace dumped by
+  ``session.dump_trace()`` (the predicted timeline rides in its
+  metadata).
+
+``--save-profile P`` persists the fitted constants as JSON; rerun
+anything under ``TEPDIST_CALIB_PROFILE=P`` to plan with measured
+constants. ``--check`` exits non-zero unless 100% of predicted tasks
+joined AND calibration strictly reduced step-time error (the CI gate,
+scripts/fidelity_smoke.sh).
+
+Run: python tools/fidelity_report.py [--steps 4 --json --save-profile P]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_fixture(steps: int = 4, top_n: int = 10,
+                step: Optional[int] = None,
+                dump_trace: Optional[str] = None) -> Dict[str, Any]:
+    """Two-worker in-proc fleet fixture -> fidelity report dict (plus
+    raw predicted timeline + measured events under private keys)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tepdist_tpu import telemetry
+    from tepdist_tpu.parallel.pipeline import plan_pipeline
+    from tepdist_tpu.rpc.inproc import (close_inproc_cluster,
+                                        make_inproc_cluster)
+    from tepdist_tpu.runtime.distributed_executor import (
+        DistributedPipelineSession,
+    )
+    from tepdist_tpu.telemetry import fidelity
+
+    telemetry.trace.configure(enabled=True)
+    telemetry.tracer().clear()
+
+    def loss_fn(params, x, y):
+        h = x
+        for i in range(4):
+            h = jnp.tanh(h @ params[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    keys = jax.random.split(k, 6)
+    params = {f"w{i}": jax.random.normal(keys[i], (16, 16)) * 0.3
+              for i in range(4)}
+    x = jax.random.normal(keys[4], (8, 16))
+    y = jax.random.normal(keys[5], (8, 16))
+
+    prog = plan_pipeline(loss_fn, 2, 2, params, x, y)
+    cluster, _servicers = make_inproc_cluster(2, jax.devices()[:1])
+    sess = DistributedPipelineSession(prog, cluster,
+                                      optimizer=optax.sgd(1e-2))
+    try:
+        sess.load_variables(params)
+        for _ in range(steps):
+            sess.step(x, y)
+        predicted = sess.schedule.predicted_timeline(sess.dag)
+        # In-proc fleet: every worker thread records into this process's
+        # tracer, so the local snapshot IS the merged fleet view.
+        events = telemetry.tracer().snapshot()
+        trace_path = (sess.dump_trace(path=dump_trace)
+                      if dump_trace else None)
+        report = fidelity.build_report(predicted, events, step=step,
+                                       top_n=top_n)
+        report["uncalibrated_makespan_ms"] = round(
+            sess.schedule.makespan * 1e3, 3)
+        report["_dag"] = sess.dag
+        report["trace"] = trace_path
+        return report
+    finally:
+        sess.close()
+        close_inproc_cluster(cluster)
+
+
+def calibrate_report(report: Dict[str, Any]) -> Dict[str, Any]:
+    """Fit a profile from the join; when the fixture DAG is available,
+    also re-simulate under the profile to show the calibrated step-time
+    prediction next to the uncalibrated one."""
+    from tepdist_tpu.core.service_env import ServiceEnv
+    from tepdist_tpu.telemetry import calibrate
+
+    prof = calibrate.fit_profile(
+        report["matched"],
+        base_overhead_us=ServiceEnv.get().task_overhead_us)
+    out: Dict[str, Any] = {"profile": json.loads(prof.to_json()),
+                           "_profile_obj": prof}
+    dag = report.get("_dag")
+    measured_ms = report.get("measured_step_ms")
+    uncal_ms = report.get("uncalibrated_makespan_ms")
+    if dag is not None:
+        from tepdist_tpu.runtime.task_scheduler import TaskScheduler
+        calibrate.set_active(prof)
+        try:
+            cal_ms = TaskScheduler(dag).schedule().makespan * 1e3
+        finally:
+            calibrate.clear_active()
+        out["calibrated_makespan_ms"] = round(cal_ms, 3)
+        if measured_ms is not None and uncal_ms is not None:
+            out["uncalibrated_error_ms"] = round(
+                abs(uncal_ms - measured_ms), 3)
+            out["calibrated_error_ms"] = round(
+                abs(cal_ms - measured_ms), 3)
+    return out
+
+
+def print_report(report: Dict[str, Any],
+                 cal: Optional[Dict[str, Any]] = None) -> None:
+    j = report["join"]
+    print(f"fidelity report — step {report['step']} "
+          f"(steps seen: {report['steps_seen']})")
+    print(f"join: {j['matched']} predicted tasks matched "
+          f"({j['fraction']:.1%}), "
+          f"{len(j['orphan_predicted'])} predicted orphans, "
+          f"{len(j['orphan_measured'])} measured orphans, "
+          f"{j['skipped_bookkeeping']} bookkeeping skipped")
+    print("per-kind drift (predicted vs measured):")
+    print(f"  {'kind':<10} {'n':>4} {'pred_ms':>10} {'meas_ms':>10} "
+          f"{'drift_ms':>10} {'ratio':>8}")
+    for kind, a in sorted(report["per_kind"].items()):
+        ratio = f"{a['ratio']:.2f}x" if a["ratio"] is not None else "-"
+        print(f"  {kind:<10} {a['n']:>4} {a['predicted_ms']:>10.3f} "
+              f"{a['measured_ms']:>10.3f} {a['drift_ms']:>10.3f} "
+              f"{ratio:>8}")
+    print(f"step time: predicted={report.get('predicted_step_ms')} ms "
+          f"measured={report.get('measured_step_ms')} ms")
+    print("attribution per worker (ms):")
+    for lane, a in report["attribution"].items():
+        print(f"  worker {lane}: window={a['window_ms']} "
+              f"compute={a['compute_ms']} collective={a['collective_ms']} "
+              f"transfer={a['transfer_ms']} serde={a['host_serde_ms']} "
+              f"idle={a['idle_ms']}")
+    top = report["top_critical_tasks"]
+    if top:
+        print(f"top {len(top)} measured critical-path tasks:")
+        for t in top:
+            print(f"  #{t['task']:<4} {t['name']:<24} {t['kind']:<8} "
+                  f"{t['dur_ms']:>9.3f} ms")
+    if cal:
+        p = cal["profile"]
+        print("calibration suggestion (telemetry/calibrate.py):")
+        print(f"  task_overhead_us={p['task_overhead_us']:.1f} "
+              f"compute_scale={p['compute_scale']:.3g} "
+              f"hbm_scale={p['hbm_scale']:.3g}")
+        print(f"  transfer_bytes_per_s={p['transfer_bytes_per_s']:.4g} "
+              f"ar_bytes_per_s={p['ar_bytes_per_s']:.4g}")
+        if "calibrated_makespan_ms" in cal:
+            print(f"  predicted step: uncalibrated="
+                  f"{report.get('uncalibrated_makespan_ms')} ms -> "
+                  f"calibrated={cal['calibrated_makespan_ms']} ms "
+                  f"(measured {report.get('measured_step_ms')} ms)")
+        if "calibrated_error_ms" in cal:
+            print(f"  abs step-time error: "
+                  f"{cal['uncalibrated_error_ms']} ms -> "
+                  f"{cal['calibrated_error_ms']} ms")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("fidelity_report")
+    ap.add_argument("--trace", default=None,
+                    help="offline: merged trace JSON from "
+                         "session.dump_trace() (metadata carries the "
+                         "predicted timeline)")
+    ap.add_argument("--steps", type=int, default=4,
+                    help="fixture mode: training steps to run")
+    ap.add_argument("--step", type=int, default=None,
+                    help="report on this step (default: last seen)")
+    ap.add_argument("--top", type=int, default=10)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--save-profile", default=None,
+                    help="write the fitted calibration profile JSON here")
+    ap.add_argument("--dump-trace", default=None,
+                    help="fixture mode: also dump the merged measured "
+                         "trace here")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 unless the join is 100%% and "
+                         "calibration strictly shrinks step-time error")
+    args = ap.parse_args(argv)
+
+    if args.trace:
+        from tepdist_tpu.telemetry import fidelity
+        with open(args.trace) as f:
+            trace = json.load(f)
+        report = fidelity.report_from_trace(trace, step=args.step,
+                                            top_n=args.top)
+        if report is None:
+            print(f"{args.trace}: no fidelity.predicted metadata — "
+                  "re-dump with session.dump_trace()", file=sys.stderr)
+            return 2
+        dropped = (trace.get("metadata") or {}).get("spans_dropped")
+        if dropped:
+            print(f"WARNING: lossy trace (spans dropped: {dropped})",
+                  file=sys.stderr)
+    else:
+        report = run_fixture(steps=args.steps, top_n=args.top,
+                             step=args.step, dump_trace=args.dump_trace)
+
+    cal = calibrate_report(report)
+    if args.save_profile:
+        cal["_profile_obj"].save(args.save_profile)
+        cal["saved"] = args.save_profile
+
+    if args.json:
+        clean = {k: v for k, v in report.items()
+                 if not k.startswith("_") and k != "matched"}
+        clean["calibration"] = {k: v for k, v in cal.items()
+                                if not k.startswith("_")}
+        print(json.dumps(clean, indent=1, default=str))
+    else:
+        print_report(report, cal)
+        if args.save_profile:
+            print(f"profile saved: {args.save_profile} "
+                  f"(use TEPDIST_CALIB_PROFILE={args.save_profile})")
+
+    if args.check:
+        j = report["join"]
+        ok = (j["fraction"] == 1.0 and not j["orphan_measured"])
+        if "calibrated_error_ms" in cal:
+            ok = ok and (cal["calibrated_error_ms"]
+                         < cal["uncalibrated_error_ms"])
+        if not ok:
+            print("fidelity check FAILED "
+                  f"(join={j['fraction']:.1%}, cal="
+                  f"{cal.get('calibrated_error_ms')} vs "
+                  f"uncal={cal.get('uncalibrated_error_ms')})",
+                  file=sys.stderr)
+            return 1
+        print("fidelity check OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""Scrape fleet metrics without Perfetto: GetTelemetry -> text.
+
+Pulls the metrics snapshot (counters / gauges / histograms with
+reservoir p50/p95/p99) from each worker address via the existing
+``GetTelemetry`` verb, folds them into one fleet view
+(``MetricsRegistry.merge``), and prints either JSON or the Prometheus
+text exposition format (``--prometheus``) — the shape a node-exporter
+sidecar or a cron scrape can ship to a real monitoring stack.
+
+Run: python tools/metrics_dump.py ADDR [ADDR...] [--prometheus] [--clear]
+     python tools/metrics_dump.py localhost:8471 --prometheus
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("metrics_dump")
+    ap.add_argument("addrs", nargs="+",
+                    help="worker addresses (host:port or inproc:<port>)")
+    ap.add_argument("--prometheus", action="store_true",
+                    help="Prometheus text format instead of JSON")
+    ap.add_argument("--clear", action="store_true",
+                    help="drain each worker's span ring while pulling")
+    args = ap.parse_args(argv)
+
+    from tepdist_tpu.rpc.client import TepdistClient
+    from tepdist_tpu.telemetry.export import to_prometheus
+    from tepdist_tpu.telemetry.metrics import MetricsRegistry
+
+    snaps = []
+    dropped = {}
+    for addr in args.addrs:
+        try:
+            h = TepdistClient(addr).get_telemetry(clear=args.clear)
+        except Exception as e:  # noqa: BLE001 — report and continue
+            print(f"{addr}: GetTelemetry failed: {e!r}", file=sys.stderr)
+            continue
+        if h.get("metrics"):
+            snaps.append(h["metrics"])
+        if h.get("spans_dropped"):
+            dropped[str(h.get("task_index", addr))] = h["spans_dropped"]
+    if not snaps:
+        print("no metrics pulled", file=sys.stderr)
+        return 1
+    merged = MetricsRegistry.merge(snaps)
+    if dropped:
+        merged.setdefault("counters", {})["spans_dropped"] = sum(
+            dropped.values())
+    if args.prometheus:
+        sys.stdout.write(to_prometheus(merged))
+    else:
+        print(json.dumps(merged, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

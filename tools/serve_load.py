@@ -78,12 +78,15 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
         statuses: Dict[str, int] = {}
         n_tokens = 0
         ttfts = []
+        decode_ms = []
         for r in reqs:
             res = results[r["rid"]]
             statuses[res["status"]] = statuses.get(res["status"], 0) + 1
             n_tokens += res.get("n_tokens", 0)
             if "ttft_ms" in res:
                 ttfts.append(res["ttft_ms"])
+            if "decode_ms" in res:
+                decode_ms.append(res["decode_ms"])
         trace_path = sc.dump_trace(trace) if trace else None
     finally:
         for s in servicers:
@@ -96,19 +99,34 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
                 - before["counters"].get(name, 0))
 
     tok_hist = after.get("histograms", {}).get("serve_token_ms", {})
+
+    def _slo(vals) -> Dict[str, Optional[float]]:
+        # SLO percentiles, not means — p95/p99 are what a latency SLO is
+        # written against.
+        if not len(vals):
+            return {"mean": None, "p50": None, "p95": None,
+                    "p99": None, "max": None}
+        return {"mean": round(float(np.mean(vals)), 3),
+                "p50": round(float(np.percentile(vals, 50)), 3),
+                "p95": round(float(np.percentile(vals, 95)), 3),
+                "p99": round(float(np.percentile(vals, 99)), 3),
+                "max": round(float(np.max(vals)), 3)}
+
     summary = {
         "requests": requests,
         "statuses": statuses,
         "wall_s": round(wall_s, 3),
         "tokens": n_tokens,
         "tokens_per_s": round(n_tokens / wall_s, 2) if wall_s else None,
-        "ttft_ms": {
-            "mean": round(float(np.mean(ttfts)), 3) if ttfts else None,
-            "p50": round(float(np.median(ttfts)), 3) if ttfts else None,
-            "max": round(float(np.max(ttfts)), 3) if ttfts else None,
-        },
+        "ttft_ms": _slo(ttfts),
+        "token_ms": {
+            k: (round(tok_hist[k], 3)
+                if tok_hist.get(k) is not None else None)
+            for k in ("mean", "p50", "p95", "p99", "max")},
         "token_ms_mean": round(tok_hist.get("mean", 0.0), 3)
         if tok_hist else None,
+        "decode_ms_mean": (round(float(np.mean(decode_ms)), 3)
+                           if decode_ms else None),
         "decode_steps": delta("serve_decode_steps"),
         "prefills": delta("serve_prefills"),
         "compiles": delta("serve_compiles"),
@@ -154,8 +172,9 @@ def main(argv=None) -> Dict[str, Any]:
         print(f"{summary['requests']} requests -> {summary['statuses']} "
               f"in {summary['wall_s']}s "
               f"({summary['tokens_per_s']} tok/s)")
-        print(f"  ttft ms: {summary['ttft_ms']}  "
-              f"token ms mean: {summary['token_ms_mean']}")
+        print(f"  ttft ms: {summary['ttft_ms']}")
+        print(f"  token ms: {summary['token_ms']}  "
+              f"decode_ms mean: {summary['decode_ms_mean']}")
         print(f"  prefills={summary['prefills']} "
               f"decode_steps={summary['decode_steps']} "
               f"compiles={summary['compiles']} "

@@ -21,7 +21,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 from typing import Any, Dict, List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 # Envelope categories: they CONTAIN task spans, so counting them toward
 # busy time would make every worker look 100% occupied.
@@ -92,13 +97,52 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
             "bubble_fraction": round(1.0 - compute_ms / window_ms, 3)
             if window_ms else None,
         }
-    return {
+    meta = trace.get("metadata", {}) or {}
+    out = {
         "n_events": len(events),
         "category_ms": {k: round(v, 3)
                         for k, v in sorted(by_cat.items())},
         "workers": workers,
-        "metrics": trace.get("metadata", {}).get("metrics"),
+        "metrics": meta.get("metrics"),
     }
+    if meta.get("spans_dropped"):
+        out["spans_dropped"] = meta["spans_dropped"]
+    fid = _fidelity_section(trace)
+    if fid is not None:
+        out["fidelity"] = fid
+    return out
+
+
+def _fidelity_section(trace: Dict[str, Any]) -> Any:
+    """Predicted-vs-measured summary when the trace embeds the
+    simulator's timeline (session.dump_trace metadata)."""
+    if not ((trace.get("metadata") or {}).get("fidelity")
+            or {}).get("predicted"):
+        return None
+    try:
+        from tepdist_tpu.telemetry import fidelity
+    except ImportError:
+        return {"error": "tepdist_tpu not importable"}
+    report = fidelity.report_from_trace(trace)
+    if report is None:
+        return None
+    return {
+        "step": report["step"],
+        "join": report["join"],
+        "per_kind": report["per_kind"],
+        "predicted_step_ms": report["predicted_step_ms"],
+        "measured_step_ms": report["measured_step_ms"],
+        "attribution": report["attribution"],
+    }
+
+
+def _pctl(h: Dict[str, Any]) -> str:
+    parts = []
+    for k in ("p50", "p95", "p99"):
+        v = h.get(k)
+        if v is not None:
+            parts.append(f"{k}={v:.3f}")
+    return " ".join(parts)
 
 
 def main() -> None:
@@ -112,6 +156,12 @@ def main() -> None:
         print(json.dumps(s, indent=1))
         return
     print(f"{s['n_events']} spans")
+    if s.get("spans_dropped"):
+        drops = ", ".join(f"{k}={v}"
+                          for k, v in sorted(s["spans_dropped"].items()))
+        print(f"WARNING: LOSSY trace — span ring overflowed ({drops}); "
+              f"missing spans read as idle time "
+              f"(raise TEPDIST_TRACE_CAPACITY)")
     print("per-category time:")
     for cat, ms in s["category_ms"].items():
         print(f"  {cat:<12} {ms:10.3f} ms")
@@ -151,9 +201,38 @@ def main() -> None:
                   "serve_batch_size"):
             h = hists.get(k)
             if h:
-                print(f"  {k:<28} mean={h['mean']:.3f} "
-                      f"min={h['min']:.3f} max={h['max']:.3f} "
-                      f"n={h['count']}")
+                # SLO percentiles (reservoir), not means — a mean hides
+                # exactly the tail the SLO is about.
+                print(f"  {k:<28} {_pctl(h)} mean={h['mean']:.3f} "
+                      f"max={h['max']:.3f} n={h['count']}")
+    rpc_hists = {k: h for k, h in
+                 ((s.get("metrics") or {}).get("histograms")
+                  or {}).items() if k.startswith("rpc_ms:")}
+    if rpc_hists:
+        print("rpc latency (ms):")
+        for k, h in sorted(rpc_hists.items()):
+            print(f"  {k:<28} {_pctl(h)} n={h['count']}")
+    fid = s.get("fidelity")
+    if fid:
+        j = fid["join"]
+        print("fidelity (predicted vs measured, "
+              f"step {fid['step']}):")
+        print(f"  join: {j['matched']} matched ({j['fraction']:.1%}), "
+              f"{len(j['orphan_predicted'])}+{len(j['orphan_measured'])} "
+              f"orphans")
+        print(f"  step: predicted={fid['predicted_step_ms']} ms "
+              f"measured={fid['measured_step_ms']} ms")
+        for kind, a in sorted(fid["per_kind"].items()):
+            ratio = (f"{a['ratio']:.2f}x" if a["ratio"] is not None
+                     else "-")
+            print(f"  {kind:<10} n={a['n']:<3} pred={a['predicted_ms']} "
+                  f"meas={a['measured_ms']} ({ratio})")
+        for lane, a in fid["attribution"].items():
+            print(f"  worker {lane}: compute={a['compute_ms']} "
+                  f"collective={a['collective_ms']} "
+                  f"transfer={a['transfer_ms']} "
+                  f"serde={a['host_serde_ms']} idle={a['idle_ms']} "
+                  f"(window {a['window_ms']} ms)")
     rest = {k: v for k, v in counters.items()
             if k not in fault and k not in serving}
     if rest:
