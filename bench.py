@@ -614,6 +614,38 @@ def bench_trace_overhead() -> dict:
     }
 
 
+def bench_plan_verify(rounds: int = 20) -> dict:
+    """Pre-dispatch plan-verifier cost on the 8-device pipeline fixture
+    (4 stages x 2 devices): verify_plan() runs every static check
+    (acyclicity, transfer pairing, wait-cycle, exactly-once, signature,
+    peak-HBM) and must stay well under 1% of the time the planner took
+    to produce the plan, so TEPDIST_VERIFY_PLAN can gate every dispatch
+    for free. ``pct_of_plan`` is the ratio this line exists to bound."""
+    from tools.verify_plan import build_fixture
+
+    from tepdist_tpu.analysis.plan_verify import verify_plan
+
+    t0 = time.perf_counter()
+    prog, dag, schedule = build_fixture(stages=4, micro=4, devices=8)
+    plan_ms = (time.perf_counter() - t0) * 1e3
+    vals = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        verify_plan(dag, schedule=schedule, prog=prog, where="bench")
+        vals.append((time.perf_counter() - t0) * 1e3)
+    vals.sort()
+    med = vals[len(vals) // 2]
+    return {
+        "metric": "plan_verify_ms",
+        "value": round(med, 3),
+        "unit": "ms",
+        "plan_ms": round(plan_ms, 1),
+        "pct_of_plan": round(100.0 * med / plan_ms, 3) if plan_ms else None,
+        "n_tasks": len(dag.nodes),
+        "gate_below_1pct": bool(plan_ms and med / plan_ms < 0.01),
+    }
+
+
 def bench_serving(n_requests: int = 16, rounds: int = 3) -> dict:
     """Continuous-batching serving throughput (tepdist_tpu/serving/):
     one engine, mixed prompt/output lengths, decode tokens/s with the
@@ -775,6 +807,11 @@ def main() -> None:
             extra.append(bench_serving())
         except Exception:
             extra.append({"metric": "serving_tok_s", "error":
+                          traceback.format_exc(limit=3).splitlines()[-1]})
+        try:
+            extra.append(bench_plan_verify())
+        except Exception:
+            extra.append({"metric": "plan_verify_ms", "error":
                           traceback.format_exc(limit=3).splitlines()[-1]})
         # Carry forward the last TPU round's secondary lines STALE-FLAGGED
         # (mirroring the headline policy) instead of silently dropping

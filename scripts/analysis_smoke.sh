@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Static-analysis smoke: both pre-dispatch gates as a CI step, mirroring
+# fidelity_smoke.sh / chaos_smoke.sh.
+#
+#   1. PLAN VERIFIER: tools/verify_plan.py --check plans the MLP
+#      pipeline fixture, runs every static check (acyclicity, SEND/RECV
+#      pairing, wait-cycle deadlock, exactly-once writes, signature,
+#      peak-HBM), then plants an orphaned SEND and fails unless the
+#      verifier rejects it naming the planted defect.
+#   2. LOCKDEP: tools/lockdep.py --check lints every threading module
+#      for lock-order inversions, bare acquires, and blocking calls
+#      under a lock — failing on any finding not justified in
+#      tepdist_tpu/analysis/lockdep_allow.toml (and on stale entries).
+#
+# Override the per-pass bound with ANALYSIS_SMOKE_TIMEOUT (seconds).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${ANALYSIS_SMOKE_TIMEOUT:-600}"
+
+echo "=== analysis smoke 1/2: plan verifier (fixture + planted defect) ==="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python tools/verify_plan.py --check
+
+echo "=== analysis smoke 2/2: concurrency lockdep ==="
+timeout -k 10 "$TIMEOUT" python tools/lockdep.py --check
+
+echo "analysis smoke: PASS"

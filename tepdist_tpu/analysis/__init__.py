@@ -1,0 +1,25 @@
+"""Static analysis: plan verification and concurrency lockdep.
+
+Two gates that run before anything ships to the fleet:
+
+- :mod:`tepdist_tpu.analysis.plan_verify` — pre-dispatch verifier over
+  the runtime :class:`TaskDAG` (acyclicity, SEND/RECV pairing, deadlock
+  wait-cycles, exactly-once writes, signature consistency, static
+  peak-HBM), gated by ``TEPDIST_VERIFY_PLAN``.
+- :mod:`tepdist_tpu.analysis.lockdep` — AST-based inter-procedural lint
+  over the repo's ``threading`` usage (lock-order inversions, bare
+  ``.acquire()``, blocking calls under a lock), with a runtime-assisted
+  mode in :mod:`tepdist_tpu.analysis.lockdep_runtime` gated by
+  ``TEPDIST_LOCKDEP``.
+
+CLIs: ``tools/verify_plan.py`` and ``tools/lockdep.py --check``.
+"""
+
+from tepdist_tpu.analysis.plan_verify import (  # noqa: F401
+    PlanVerificationError,
+    PlanVerifyReport,
+    maybe_verify_plan,
+    verify_enabled,
+    verify_plan,
+    verify_servable,
+)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -102,6 +103,18 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
     ("LOWERING_POSTCHECK", bool, True, "winner-only involuntary-remat "
      "lowering check after exploration (parallel/lowering_check.py); "
      "records the involuntary_remat counter + a warning"),
+    # --- static analysis --------------------------------------------------
+    ("TEPDIST_VERIFY_PLAN", bool,
+     "pytest" in sys.modules or "PYTEST_CURRENT_TEST" in os.environ,
+     "pre-dispatch static plan verifier (analysis/plan_verify.py): "
+     "acyclicity, SEND/RECV pairing, cross-worker wait-cycle (deadlock), "
+     "exactly-once writes, signature consistency, static peak-HBM — run "
+     "on every built plan before dispatch (executor, distributed "
+     "session, LoadServable). Default: on under pytest, off otherwise"),
+    ("TEPDIST_LOCKDEP", bool, False, "runtime-assisted lockdep "
+     "(analysis/lockdep_runtime.py): instrumented lock wrappers record "
+     "actual acquisition-order edges to confirm/retire static "
+     "lock-order edges from tools/lockdep.py"),
 ]
 
 _CONFIG_FILE_ENV = "TEPDIST_CONFIG"

@@ -1299,6 +1299,20 @@ class TepdistServicer:
             sid = f"sv{self._servable_next}"
             self._servable_next += 1
         name = header.get("name") or sid
+        # Pre-load gate (TEPDIST_VERIFY_PLAN): reject a servable whose
+        # KV-cache plan can't fit HBM before compiling anything.
+        from tepdist_tpu.analysis.plan_verify import (verify_enabled,
+                                                      verify_servable)
+        if verify_enabled():
+            from tepdist_tpu.serving.kv_cache import default_buckets
+            v_slots = int(header.get("slots", 4))
+            v_max_len = int(header.get("max_len") or cfg.n_ctx)
+            v_buckets = sorted({min(int(b), v_max_len) for b in
+                                (header.get("buckets")
+                                 or default_buckets(v_max_len))})
+            verify_servable(cfg, slots=v_slots, max_len=v_max_len,
+                            buckets=v_buckets,
+                            where=f"LoadServable@{self.task_index}")
         eng = ServingSupervisor(
             params, cfg, slots=int(header.get("slots", 4)),
             max_len=header.get("max_len"),

@@ -87,6 +87,11 @@ class DistributedPipelineSession:
         self.schedule = TaskScheduler(self.dag).schedule()
         sched = self.schedule
         order = sched.order
+        # Pre-dispatch gate (TEPDIST_VERIFY_PLAN): a broken DAG must not
+        # reach the fleet — verify before any DispatchPlan ships.
+        from tepdist_tpu.analysis.plan_verify import maybe_verify_plan
+        maybe_verify_plan(self.dag, schedule=sched, prog=prog,
+                          where="DistributedPipelineSession")
 
         # Per-worker ordered task lists + send routing.
         batch_set = set(prog.batch_flat_indices)
@@ -383,7 +388,7 @@ class DistributedPipelineSession:
 
         status = self.health.check_once()
         newly_dead = {ti for ti in errs if not status.get(ti, False)}
-        self.health.dead |= newly_dead
+        self.health.mark_dead(newly_dead)
         # A straggler thread still alive here means some ExecuteRemotePlan
         # may STILL be running server-side; likewise a deadline-exceeded
         # execute on a ping-alive worker. Re-executing concurrently with

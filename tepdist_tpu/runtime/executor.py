@@ -180,6 +180,11 @@ class PipelineExecutable:
         # Rebuild the GC plan for the CHOSEN order (candidate simulations may
         # have left a different order's plan in place).
         self.dag.build_gc_plan(self.schedule.order)
+        # Pre-dispatch gate (TEPDIST_VERIFY_PLAN): the explore winner's
+        # .build() lands here, so a planner bug is caught before compile.
+        from tepdist_tpu.analysis.plan_verify import maybe_verify_plan
+        maybe_verify_plan(self.dag, schedule=self.schedule, prog=prog,
+                          where="PipelineExecutable")
         self.optimizer = optimizer
 
         # Param ownership: flat invar idx -> owning stage (first consumer).

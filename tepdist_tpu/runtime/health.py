@@ -16,11 +16,21 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
+from tepdist_tpu.analysis.lockdep_runtime import make_lock
+
 log = logging.getLogger(__name__)
 
 
 class HealthMonitor:
-    """Periodic Ping over a set of TepdistClients."""
+    """Periodic Ping over a set of TepdistClients.
+
+    ``misses``/``dead``/``last_seen`` are mutated from the heartbeat
+    thread AND from session threads (``revive``, ``mark_dead`` during
+    elastic re-dispatch), so every state transition takes ``_lock``. The
+    Ping RPC itself runs OUTSIDE the lock — a slow worker must not hold
+    health state hostage for ``timeout_s`` (and lockdep flags RPC under
+    a lock); ``on_failure`` fires outside it too, since callbacks take
+    their own locks."""
 
     def __init__(self, clients: Dict[int, "object"],
                  interval_s: float = 5.0,
@@ -36,6 +46,7 @@ class HealthMonitor:
         self.dead: set = set()
         self.last_seen: Dict[int, float] = {}
         self.last_rtt_ms: Dict[int, float] = {}
+        self._lock = make_lock("HealthMonitor._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -43,12 +54,21 @@ class HealthMonitor:
     def revive(self, ti: int) -> None:
         """Clear a worker's dead mark + miss count (its process came back
         or the partition healed). The next sweep treats it as healthy."""
-        if ti in self.dead:
+        with self._lock:
+            if ti not in self.dead:
+                return
             self.dead.discard(ti)
             self.misses[ti] = 0
-            from tepdist_tpu.telemetry import metrics
-            metrics().counter("worker_revived").inc()
-            log.warning("worker %d revived (heartbeat answered again)", ti)
+        from tepdist_tpu.telemetry import metrics
+        metrics().counter("worker_revived").inc()
+        log.warning("worker %d revived (heartbeat answered again)", ti)
+
+    def mark_dead(self, tis: Sequence[int]) -> None:
+        """Declare workers dead from outside the heartbeat loop (the
+        session's recovery path observed execute-time failures before the
+        next sweep would have)."""
+        with self._lock:
+            self.dead |= set(tis)
 
     def check_once(self) -> Dict[int, bool]:
         """One synchronous sweep; returns {task_index: healthy}.
@@ -60,7 +80,8 @@ class HealthMonitor:
         iteration."""
         status: Dict[int, bool] = {}
         for ti, client in list(self.clients.items()):
-            was_dead = ti in self.dead
+            with self._lock:
+                was_dead = ti in self.dead
             try:
                 from tepdist_tpu.rpc import protocol
                 from tepdist_tpu.telemetry import metrics
@@ -73,9 +94,10 @@ class HealthMonitor:
                 if ok:
                     if was_dead:
                         self.revive(ti)
-                    self.misses[ti] = 0
-                    self.last_seen[ti] = time.time()
-                    self.last_rtt_ms[ti] = rtt_ms
+                    with self._lock:
+                        self.misses[ti] = 0
+                        self.last_seen[ti] = time.time()
+                        self.last_rtt_ms[ti] = rtt_ms
                     m = metrics()
                     m.gauge(f"heartbeat_rtt_ms:{ti}").set(rtt_ms)
                     m.histogram("heartbeat_rtt_ms").observe(rtt_ms)
@@ -84,11 +106,15 @@ class HealthMonitor:
                 status[ti] = False
                 if was_dead:
                     continue   # still dead; on_failure already fired once
-                self.misses[ti] = self.misses.get(ti, 0) + 1
-                if self.misses[ti] >= self.max_misses:
-                    self.dead.add(ti)
+                with self._lock:
+                    self.misses[ti] = self.misses.get(ti, 0) + 1
+                    newly_dead = self.misses[ti] >= self.max_misses
+                    if newly_dead:
+                        self.dead.add(ti)
+                    n_misses = self.misses[ti]
+                if newly_dead:
                     log.error("worker %d declared dead after %d missed "
-                              "heartbeats: %s", ti, self.misses[ti], e)
+                              "heartbeats: %s", ti, n_misses, e)
                     if self.on_failure is not None:
                         try:
                             self.on_failure(ti, e)

@@ -22,6 +22,20 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from tepdist_tpu.core.mesh import SplitId
 
 
+class TaskGraphError(ValueError):
+    """Typed task-graph defect. ``kind`` names the violated invariant and
+    ``tasks`` carries the offending task ids, so construction errors and
+    the plan verifier's counterexamples (analysis/plan_verify.py) read
+    the same way."""
+
+    def __init__(self, kind: str, message: str,
+                 tasks: Sequence[int] = ()):
+        self.kind = kind
+        self.tasks = tuple(tasks)
+        suffix = f" tasks={list(self.tasks)}" if self.tasks else ""
+        super().__init__(f"[{kind}] {message}{suffix}")
+
+
 class TaskType(enum.Enum):
     SPLIT = "split"      # source: distributes per-step inputs
     INPUT = "input"      # routes args onto a device group
@@ -89,11 +103,26 @@ class TaskDAG:
 
     def add_edge(self, parent: TaskNode, child: TaskNode,
                  out_idx: int = 0, arg_pos: Optional[int] = None) -> None:
+        if parent.id == child.id:
+            raise TaskGraphError(
+                "self_edge", f"{parent.key()} cannot depend on itself",
+                tasks=(parent.id,))
         if child.id not in parent.children:
             parent.children.append(child.id)
         if parent.id not in child.parents:
             child.parents.append(parent.id)
         if arg_pos is not None:
+            prev = child.input_specs.get(arg_pos)
+            # Identical rewires are idempotent (shared params are wired
+            # once per consumer micro-batch); a DIFFERENT producer for a
+            # wired arg is a double write.
+            if prev is not None and prev != (parent.id, out_idx):
+                raise TaskGraphError(
+                    "double_write",
+                    f"{child.key()} arg {arg_pos} already wired from "
+                    f"task {prev[0]} out {prev[1]}, rewire from "
+                    f"{parent.key()} out {out_idx}",
+                    tasks=(prev[0], parent.id, child.id))
             child.input_specs[arg_pos] = (parent.id, out_idx)
 
     def node(self, task_id: int) -> TaskNode:
@@ -111,15 +140,25 @@ class TaskDAG:
                 if indeg[c] == 0:
                     ready.append(self.nodes[c])
         if len(out) != len(self.nodes):
-            raise ValueError("TaskDAG has a cycle")
+            done = {n.id for n in out}
+            stuck = sorted(n.id for n in self.nodes if n.id not in done)
+            names = ", ".join(self.nodes[t].key() for t in stuck[:8])
+            raise TaskGraphError(
+                "cycle",
+                f"TaskDAG has a cycle among {len(stuck)} tasks: {names}"
+                + ("..." if len(stuck) > 8 else ""),
+                tasks=stuck)
         return out
 
     def validate(self) -> None:
         self.topo_order()
         for n in self.nodes:
             for pos, (pid, oi) in n.input_specs.items():
-                assert pid in n.parents, (
-                    f"{n.key()} arg {pos} from non-parent {pid}")
+                if pid not in n.parents:
+                    raise TaskGraphError(
+                        "structure",
+                        f"{n.key()} arg {pos} wired from non-parent "
+                        f"task {pid}", tasks=(n.id, pid))
 
     # -- GC plan ----------------------------------------------------------
     def build_gc_plan(self, order: Optional[Sequence[int]] = None) -> None:

@@ -54,6 +54,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import jax
 import numpy as np
 
+from tepdist_tpu.analysis.lockdep_runtime import make_condition
 from tepdist_tpu.models.gpt2 import GPT2Config
 from tepdist_tpu.models.sampling import _split_data
 from tepdist_tpu.runtime import faults
@@ -135,7 +136,7 @@ class ServingEngine:
         self._reqs: Dict[str, ServeRequest] = {}
         self._queue: deque = deque()
         self._active: Dict[int, str] = {}        # slot -> rid
-        self._cv = threading.Condition()
+        self._cv = make_condition("ServingEngine._cv")
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._draining = False

@@ -58,6 +58,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from tepdist_tpu.analysis.lockdep_runtime import make_rlock
 from tepdist_tpu.models.gpt2 import GPT2Config
 from tepdist_tpu.serving.engine import TERMINAL, ServingEngine
 from tepdist_tpu.telemetry import metrics
@@ -112,7 +113,9 @@ class ServingSupervisor:
                 f"{self.shed_low}/{self.shed_high}")
         # RLock: _recover runs under it and calls submit-adjacent engine
         # methods; poll/submit from RPC threads serialize against it.
-        self._lock = threading.RLock()
+        # Lock order: ServingSupervisor._lock before ServingEngine._cv,
+        # never the reverse (on_fault fires outside _cv).
+        self._lock = make_rlock("ServingSupervisor._lock")
         self._journal: Dict[str, _JournalEntry] = {}
         self._completed: Dict[str, Dict[str, Any]] = {}  # dead-gen results
         self._shedding = False

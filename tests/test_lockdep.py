@@ -1,0 +1,234 @@
+"""Lockdep tests: the static analyzer on synthetic sources, the
+allowlist parser, the repo-wide CI gate, and the runtime-assisted mode
+confirming the supervisor -> engine lock order on a live engine."""
+
+import textwrap
+
+import jax
+import numpy as np
+import pytest
+
+from tepdist_tpu.analysis import lockdep, lockdep_runtime
+from tepdist_tpu.analysis.lockdep import (
+    analyze,
+    is_allowed,
+    load_allowlist,
+)
+
+pytestmark = pytest.mark.serving
+
+
+# ---------------------------------------------------------------------
+# static analyzer on synthetic sources
+# ---------------------------------------------------------------------
+
+SYNTH = textwrap.dedent('''
+    import queue
+    import threading
+    import time
+
+
+    class Worker:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+            self.q = queue.Queue()
+            self.cv = threading.Condition()
+
+        def ab(self):
+            with self.a:
+                with self.b:
+                    pass
+
+        def ba(self):
+            with self.b:
+                with self.a:
+                    pass
+
+        def leak(self):
+            self.a.acquire()
+            return 1
+
+        def guarded(self):
+            self.b.acquire()
+            try:
+                return 2
+            finally:
+                self.b.release()
+
+        def sleepy(self):
+            with self.a:
+                time.sleep(1.0)
+
+        def parked(self):
+            with self.cv:
+                self.cv.wait()
+
+        def bounded(self):
+            with self.cv:
+                self.cv.wait(0.5)
+
+        def pulls(self):
+            with self.a:
+                self.q.get()
+
+        def pulls_bounded(self):
+            with self.a:
+                self.q.get(timeout=1.0)
+
+        def helper(self):
+            with self.b:
+                pass
+
+        def indirect(self):
+            with self.a:
+                self.helper()
+''')
+
+
+@pytest.fixture(scope="module")
+def synth_report(tmp_path_factory):
+    root = tmp_path_factory.mktemp("synth")
+    pkg = root / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(SYNTH)
+    return analyze(str(root), package="pkg")
+
+
+def test_static_lock_registry_and_edges(synth_report):
+    assert {"Worker.a", "Worker.b", "Worker.cv"} <= set(synth_report.locks)
+    edges = synth_report.static_edges()
+    assert ("Worker.a", "Worker.b") in edges     # ab() + indirect()
+    assert ("Worker.b", "Worker.a") in edges     # ba()
+
+
+def test_static_inversion_detected(synth_report):
+    inv = [f for f in synth_report.findings if f.kind == "lock_inversion"]
+    assert len(inv) == 1
+    assert "Worker.a" in inv[0].detail and "Worker.b" in inv[0].detail
+    # Example sites in both directions are part of the message.
+    assert "Worker.a -> Worker.b" in inv[0].message
+    assert "Worker.b -> Worker.a" in inv[0].message
+
+
+def test_static_bare_acquire(synth_report):
+    bare = [f for f in synth_report.findings if f.kind == "bare_acquire"]
+    assert [f.func for f in bare] == ["Worker.leak"]   # guarded() is fine
+
+
+def test_static_blocking_under_lock(synth_report):
+    blk = {f.func: f.detail for f in synth_report.findings
+           if f.kind == "blocking_under_lock"}
+    assert blk.get("Worker.sleepy", "").startswith("time.sleep")
+    assert blk.get("Worker.parked", "").startswith("wait@Worker.cv")
+    assert blk.get("Worker.pulls", "").startswith("queue.get@q")
+    assert "Worker.bounded" not in blk          # wait(0.5) is bounded
+    assert "Worker.pulls_bounded" not in blk    # get(timeout=) is bounded
+
+
+def test_interprocedural_edge(synth_report):
+    via = [e for e in synth_report.edges
+           if (e.outer, e.inner) == ("Worker.a", "Worker.b") and e.via]
+    assert via and "helper" in via[0].via
+
+
+# ---------------------------------------------------------------------
+# allowlist parser + matching
+# ---------------------------------------------------------------------
+
+def test_allowlist_parser_and_globs(tmp_path):
+    p = tmp_path / "allow.toml"
+    p.write_text(textwrap.dedent('''
+        # a comment
+        [[allow]]
+        key = "bare_acquire:pkg/mod.py:Worker.leak:Worker.a"
+        reason = "released by a callback"
+
+        [[allow]]
+        key = "blocking_under_lock:pkg/mod.py:*"
+        reason = "demo glob"
+    '''))
+    allow = load_allowlist(str(p))
+    assert len(allow) == 2
+    f = lockdep.Finding(kind="bare_acquire", file="pkg/mod.py",
+                        func="Worker.leak", detail="Worker.a", line=1,
+                        message="")
+    assert is_allowed(f, allow)
+    g = lockdep.Finding(kind="blocking_under_lock", file="pkg/mod.py",
+                        func="Worker.sleepy", detail="time.sleep|held=x",
+                        line=1, message="")
+    assert is_allowed(g, allow)
+    h = lockdep.Finding(kind="lock_inversion", file="pkg/mod.py",
+                        func="Worker.ab", detail="a<->b", line=1,
+                        message="")
+    assert not is_allowed(h, allow)
+
+
+def test_allowlist_requires_reason(tmp_path):
+    p = tmp_path / "allow.toml"
+    p.write_text('[[allow]]\nkey = "x"\n')
+    with pytest.raises(ValueError, match="reason"):
+        load_allowlist(str(p))
+
+
+# ---------------------------------------------------------------------
+# the repo-wide gate (same assertion as tools/lockdep.py --check)
+# ---------------------------------------------------------------------
+
+def test_repo_is_lockdep_clean():
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rep = analyze(root)
+    allow = load_allowlist(os.path.join(
+        root, "tepdist_tpu", "analysis", "lockdep_allow.toml"))
+    flagged = [f.key for f in rep.findings if not is_allowed(f, allow)]
+    assert not flagged, f"un-allowlisted lockdep findings: {flagged}"
+    # No inversions may EVER be allowlisted away silently: the repo's
+    # static lock-order graph must be inversion-free outright.
+    assert not [f for f in rep.findings if f.kind == "lock_inversion"]
+    # The supervisor -> engine order is visible statically.
+    assert ("ServingSupervisor._lock", "ServingEngine._cv") \
+        in rep.static_edges()
+
+
+# ---------------------------------------------------------------------
+# runtime-assisted mode: live engine under TEPDIST_LOCKDEP=1
+# ---------------------------------------------------------------------
+
+def test_runtime_mode_confirms_supervisor_engine_order(monkeypatch):
+    from tepdist_tpu.models import gpt2
+    from tepdist_tpu.serving.supervisor import ServingSupervisor
+
+    monkeypatch.setenv("TEPDIST_LOCKDEP", "1")
+    lockdep_runtime.reset_edges()
+    cfg = gpt2.CONFIGS["test"]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    sup = ServingSupervisor(params, cfg, slots=2, max_len=32)
+    sup.start()
+    try:
+        p = np.arange(1, 6, dtype=np.int32) % cfg.vocab_size
+        assert sup.submit("r0", p, max_new_tokens=4)["status"] == "queued"
+        res = sup.poll(["r0"], wait_ms=10000)[0]
+        assert res["status"] == "done"
+    finally:
+        sup.stop(timeout=10.0)
+    observed = lockdep_runtime.edges()
+    # The supervisor takes its lock, then the engine's condition — the
+    # statically-derived order, confirmed on a live run.
+    assert ("ServingSupervisor._lock", "ServingEngine._cv") in observed
+    # And never the inverse (that would be the ABBA deadlock).
+    assert ("ServingEngine._cv", "ServingSupervisor._lock") not in observed
+    assert lockdep_runtime.confirms(
+        {("ServingSupervisor._lock", "ServingEngine._cv")})
+
+
+def test_factories_return_plain_primitives_when_off(monkeypatch):
+    monkeypatch.delenv("TEPDIST_LOCKDEP", raising=False)
+    import threading
+    assert isinstance(lockdep_runtime.make_lock("x"),
+                      type(threading.Lock()))
+    monkeypatch.setenv("TEPDIST_LOCKDEP", "1")
+    lk = lockdep_runtime.make_lock("x")
+    assert isinstance(lk, lockdep_runtime._TrackedLock)
+    with lk:
+        pass

@@ -1,0 +1,282 @@
+"""Plan-verifier tests: clean fixtures verify clean, and a DAG-mutation
+fuzzer plants seeded corruptions that must each be rejected with a
+counterexample naming the planted defect."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tepdist_tpu.analysis.plan_verify import (
+    PlanVerificationError,
+    maybe_verify_plan,
+    verify_enabled,
+    verify_plan,
+    verify_servable,
+)
+from tepdist_tpu.core.service_env import ServiceEnv
+from tepdist_tpu.parallel.pipeline import plan_pipeline
+from tepdist_tpu.runtime.execution_plan import build_pipeline_task_dag
+from tepdist_tpu.runtime.task_graph import (
+    TaskDAG,
+    TaskGraphError,
+    TaskType,
+)
+from tepdist_tpu.runtime.task_scheduler import TaskScheduler
+from tepdist_tpu.telemetry import metrics
+
+
+def _loss_fn(params, x, y):
+    h = x
+    for w in params:
+        h = jnp.tanh(h @ w)
+    return jnp.mean((h - y) ** 2)
+
+
+def _make_prog(stages, micro, n_layer, width=16, batch=8):
+    key = jax.random.PRNGKey(0)
+    params = [jax.random.normal(jax.random.fold_in(key, i),
+                                (width, width)) * 0.1
+              for i in range(n_layer)]
+    x = jax.random.normal(jax.random.fold_in(key, 100), (batch, width))
+    y = jax.random.normal(jax.random.fold_in(key, 101), (batch, width))
+    return plan_pipeline(_loss_fn, stages, micro, params, x, y)
+
+
+@pytest.fixture(scope="module")
+def prog2():
+    return _make_prog(2, 2, 4)
+
+
+def _fresh_plan(prog, per_stage=1):
+    S = prog.num_stages
+    stage_devices = [tuple(range(s * per_stage, (s + 1) * per_stage))
+                     for s in range(S)]
+    dag, maps = build_pipeline_task_dag(prog, stage_devices)
+    schedule = TaskScheduler(dag).schedule()
+    return dag, maps, schedule
+
+
+# ---------------------------------------------------------------------
+# negative tests: real plans verify clean
+# ---------------------------------------------------------------------
+
+def test_fixture_plan_verifies_clean(prog2):
+    dag, _maps, schedule = _fresh_plan(prog2)
+    rep = verify_plan(dag, schedule=schedule, prog=prog2)
+    assert rep.n_tasks == len(dag.nodes)
+    assert "wait_cycle" in rep.checks and "signature" in rep.checks
+    assert rep.peak_bytes  # replay visited every device
+
+
+def test_four_stage_two_dev_per_stage_clean():
+    prog = _make_prog(4, 2, 8)
+    dag, _maps, schedule = _fresh_plan(prog, per_stage=2)
+    rep = verify_plan(dag, schedule=schedule, prog=prog)
+    assert rep.n_tasks == len(dag.nodes)
+    # 4 stages on distinct groups => cross-stage transfers exist
+    assert any(n.task_type == TaskType.SEND for n in dag.nodes)
+
+
+def test_verify_on_by_default_under_pytest_and_counts(prog2):
+    assert verify_enabled()
+    before = metrics().counter("plan_verified").value
+    dag, _maps, schedule = _fresh_plan(prog2)
+    assert maybe_verify_plan(dag, schedule=schedule, prog=prog2) is not None
+    assert metrics().counter("plan_verified").value == before + 1
+
+
+def test_gate_is_a_noop_when_disabled(prog2):
+    env = ServiceEnv.get()
+    env.set("TEPDIST_VERIFY_PLAN", False)
+    try:
+        dag, _maps, _sched = _fresh_plan(prog2)
+        send = next(n for n in dag.nodes if n.task_type == TaskType.SEND)
+        send.children.clear()  # corrupt — but the gate is off
+        assert maybe_verify_plan(dag) is None
+    finally:
+        env.set("TEPDIST_VERIFY_PLAN", True)
+
+
+# ---------------------------------------------------------------------
+# the fuzzer: seeded corruptions, each named in the counterexample
+# ---------------------------------------------------------------------
+
+def _first_send(dag):
+    return next(n for n in dag.nodes if n.task_type == TaskType.SEND)
+
+
+def corrupt_drop_recv(dag, maps, prog):
+    """Detach the RECV from its SEND: the SEND now feeds nobody."""
+    send = _first_send(dag)
+    recv = dag.nodes[send.children[0]]
+    send.children.remove(recv.id)
+    recv.parents.remove(send.id)
+    recv.input_specs.pop(0, None)
+    return "orphan_send", {send.id}
+
+
+def corrupt_retype_send(dag, maps, prog):
+    """Turn the SEND into a plain COMPUTE: its RECV loses its producer."""
+    send = _first_send(dag)
+    recv = dag.nodes[send.children[0]]
+    send.task_type = TaskType.COMPUTE
+    return "orphan_recv", {recv.id}
+
+
+def corrupt_reverse_edge(dag, maps, prog):
+    """Reverse the fwd(0,0) -> bwd(0,0) control edge: with the
+    cross-stage cotangent path, that closes a dataflow cycle."""
+    fwd = dag.node(maps.fwd_tasks[(0, 0)])
+    bwd = dag.node(maps.bwd_tasks[(0, 0)])
+    fwd.children.remove(bwd.id)
+    bwd.parents.remove(fwd.id)
+    bwd.children.append(fwd.id)
+    fwd.parents.append(bwd.id)
+    return "cycle", {fwd.id, bwd.id}
+
+
+def corrupt_double_write(dag, maps, prog):
+    """A second APPLY for stage 0: two writers for its variables."""
+    orig = maps.apply_tasks[0]
+    dup = dag.add(TaskType.APPLY, "apply_s0_dup", stage=0,
+                  device_group=dag.node(orig).device_group)
+    return "double_write", {orig, dup.id}
+
+
+def corrupt_inflate_buffer(dag, maps, prog):
+    """One activation balloons past the chip's HBM."""
+    fwd = dag.node(maps.fwd_tasks[(0, 0)])
+    fwd.out_bytes = 1e18
+    return "hbm_overflow", {fwd.id}
+
+
+def corrupt_transfer_bytes(dag, maps, prog):
+    """SEND and RECV disagree on the transferred byte count (a
+    shape/dtype mismatch across the wire)."""
+    send = _first_send(dag)
+    recv = dag.nodes[send.children[0]]
+    recv.out_bytes = send.out_bytes + 1337.0
+    return "transfer_bytes_mismatch", {send.id, recv.id}
+
+
+def corrupt_wire_from_non_parent(dag, maps, prog):
+    """An input spec pointing at a task that is not a parent."""
+    bwd = dag.node(maps.bwd_tasks[(0, 0)])
+    stranger = maps.fwd_tasks[(1, 1)]
+    assert stranger not in bwd.parents
+    bwd.input_specs[99] = (stranger, 0)
+    return "structure", {bwd.id, stranger}
+
+
+CORRUPTIONS = [
+    corrupt_drop_recv,
+    corrupt_retype_send,
+    corrupt_reverse_edge,
+    corrupt_double_write,
+    corrupt_inflate_buffer,
+    corrupt_transfer_bytes,
+    corrupt_wire_from_non_parent,
+]
+
+
+@pytest.mark.parametrize("corrupt", CORRUPTIONS,
+                         ids=lambda c: c.__name__)
+def test_fuzzer_rejects_each_corruption(prog2, corrupt):
+    dag, maps, _sched = _fresh_plan(prog2)
+    want_kind, want_tasks = corrupt(dag, maps, prog2)
+    with pytest.raises(PlanVerificationError) as ei:
+        # No precomputed order: the mutated graph gets a fresh topo
+        # order (the scheduler's order no longer covers added nodes).
+        verify_plan(dag, prog=prog2)
+    err = ei.value
+    assert err.kind == want_kind, f"wanted {want_kind}, got {err}"
+    # The counterexample names the planted defect.
+    assert want_tasks & set(err.tasks), \
+        f"counterexample {err.tasks} does not name planted {want_tasks}"
+
+
+def test_wait_cycle_deadlock_detected(prog2):
+    """Two workers each scheduled recv-before-send for opposite-direction
+    transfers: classic cross-worker deadlock, invisible to plain
+    dataflow acyclicity."""
+    dag, _maps, schedule = _fresh_plan(prog2)
+    dev0 = None
+    act_send = cot_recv = None
+    for n in dag.nodes:
+        if n.task_type == TaskType.SEND and act_send is None:
+            dev0 = n.device_group
+            act_send = n
+        elif n.task_type == TaskType.RECV and n.device_group == dev0 \
+                and dag.nodes[n.parents[0]].device_group != dev0:
+            cot_recv = n
+    assert act_send is not None and cot_recv is not None
+    order = [t for t in schedule.order if t != cot_recv.id]
+    order.insert(order.index(act_send.id), cot_recv.id)
+    with pytest.raises(PlanVerificationError) as ei:
+        verify_plan(dag, order=order)
+    assert ei.value.kind == "wait_cycle"
+    assert {act_send.id, cot_recv.id} & set(ei.value.tasks)
+
+
+# ---------------------------------------------------------------------
+# typed task-graph construction errors
+# ---------------------------------------------------------------------
+
+def test_topo_order_cycle_names_tasks():
+    dag = TaskDAG()
+    a = dag.add(TaskType.COMPUTE, "a")
+    b = dag.add(TaskType.COMPUTE, "b")
+    dag.add_edge(a, b)
+    dag.add_edge(b, a)
+    with pytest.raises(TaskGraphError) as ei:
+        dag.topo_order()
+    assert ei.value.kind == "cycle"
+    assert set(ei.value.tasks) == {a.id, b.id}
+
+
+def test_add_edge_rejects_self_edge_and_conflicting_rewire():
+    dag = TaskDAG()
+    a = dag.add(TaskType.COMPUTE, "a")
+    b = dag.add(TaskType.COMPUTE, "b")
+    c = dag.add(TaskType.COMPUTE, "c")
+    with pytest.raises(TaskGraphError) as ei:
+        dag.add_edge(a, a)
+    assert ei.value.kind == "self_edge"
+    dag.add_edge(a, c, out_idx=0, arg_pos=0)
+    dag.add_edge(a, c, out_idx=0, arg_pos=0)  # identical rewire: ok
+    with pytest.raises(TaskGraphError) as ei:
+        dag.add_edge(b, c, out_idx=0, arg_pos=0)
+    assert ei.value.kind == "double_write"
+    assert {a.id, b.id, c.id} == set(ei.value.tasks)
+
+
+def test_validate_names_non_parent_wire():
+    dag = TaskDAG()
+    a = dag.add(TaskType.COMPUTE, "a")
+    b = dag.add(TaskType.COMPUTE, "b")
+    b.input_specs[0] = (a.id, 0)   # no edge added
+    with pytest.raises(TaskGraphError) as ei:
+        dag.validate()
+    assert ei.value.kind == "structure"
+    assert set(ei.value.tasks) == {b.id, a.id}
+
+
+# ---------------------------------------------------------------------
+# serving-plan gate
+# ---------------------------------------------------------------------
+
+def test_verify_servable_clean_and_overflow():
+    from tepdist_tpu.models.gpt2 import GPT2Config
+    cfg = GPT2Config(vocab_size=256, n_ctx=64, n_embd=32, n_layer=2,
+                     n_head=2)
+    verify_servable(cfg, slots=2, max_len=32, buckets=[8, 16, 32])
+    with pytest.raises(PlanVerificationError) as ei:
+        verify_servable(cfg, slots=2, max_len=32, buckets=[8, 16, 32],
+                        hbm_limit_bytes=1e4)
+    assert ei.value.kind == "hbm_overflow"
+    with pytest.raises(PlanVerificationError):
+        verify_servable(cfg, slots=2, max_len=32, buckets=[16, 8])
+    with pytest.raises(PlanVerificationError):
+        verify_servable(cfg, slots=0, max_len=32, buckets=[8])
+    with pytest.raises(PlanVerificationError):
+        verify_servable(cfg, slots=2, max_len=32, buckets=[8, 64])

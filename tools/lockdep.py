@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Concurrency lockdep CLI (analysis/lockdep.py).
+
+    python tools/lockdep.py             # full report: locks, edges, findings
+    python tools/lockdep.py --check     # CI gate: fail on un-allowlisted
+    TEPDIST_LOCKDEP=1 pytest ... ; python tools/lockdep.py --confirm edges.json
+
+``--check`` exits 1 if any finding is not justified in
+``tepdist_tpu/analysis/lockdep_allow.toml`` (and 2 if an allowlist entry
+no longer matches anything — stale entries must be deleted, not hoarded).
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tepdist_tpu.analysis.lockdep import (  # noqa: E402
+    analyze,
+    is_allowed,
+    load_allowlist,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALLOWLIST = os.path.join(ROOT, "tepdist_tpu", "analysis",
+                         "lockdep_allow.toml")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=ROOT)
+    ap.add_argument("--allowlist", default=ALLOWLIST)
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero on un-allowlisted findings "
+                         "or stale allowlist entries")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args()
+
+    rep = analyze(args.root)
+    allow = load_allowlist(args.allowlist)
+    flagged = [f for f in rep.findings if not is_allowed(f, allow)]
+    allowed = [f for f in rep.findings if is_allowed(f, allow)]
+    stale = [e["key"] for e in allow
+             if not any(fnmatch.fnmatchcase(f.key, e["key"])
+                        for f in rep.findings)]
+    edge_set = sorted(rep.static_edges())
+
+    if args.json:
+        print(json.dumps({
+            "files_scanned": rep.files_scanned,
+            "locks": rep.locks,
+            "edges": edge_set,
+            "findings": [f.key for f in flagged],
+            "allowed": [f.key for f in allowed],
+            "stale_allowlist": stale,
+        }, indent=2))
+    else:
+        print(f"lockdep: scanned {rep.files_scanned} threading modules, "
+              f"{len(rep.locks)} locks, {len(edge_set)} order edges")
+        for a, b in edge_set:
+            print(f"  order: {a} -> {b}")
+        if allowed:
+            print(f"{len(allowed)} allowlisted finding(s):")
+            for f in allowed:
+                print(f"  [allowed] {f.key}")
+        if flagged:
+            print(f"{len(flagged)} finding(s) NOT allowlisted:")
+            for f in flagged:
+                print(f"  [{f.kind}] {f.file}:{f.line} {f.func}: "
+                      f"{f.message}")
+                print(f"      key: {f.key}")
+        else:
+            print("no un-allowlisted findings")
+        if stale:
+            print(f"{len(stale)} STALE allowlist entr(ies) — the finding "
+                  f"no longer exists; delete them:")
+            for k in stale:
+                print(f"  stale: {k}")
+
+    if args.check:
+        if flagged:
+            return 1
+        if stale:
+            return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -233,8 +233,14 @@ def main() -> None:
                   f"transfer={a['transfer_ms']} "
                   f"serde={a['host_serde_ms']} idle={a['idle_ms']} "
                   f"(window {a['window_ms']} ms)")
+    analysis = {k: v for k, v in counters.items()
+                if k in ("plan_verified", "lockdep_runtime_edges")}
+    if analysis:
+        print("static analysis:")
+        for k, v in sorted(analysis.items()):
+            print(f"  {k:<28} {v}")
     rest = {k: v for k, v in counters.items()
-            if k not in fault and k not in serving}
+            if k not in fault and k not in serving and k not in analysis}
     if rest:
         print("counters:")
         for k, v in sorted(rest.items()):

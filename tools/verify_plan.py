@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Plan-verifier CLI (analysis/plan_verify.py).
+
+    python tools/verify_plan.py            # verify the built-in fixtures
+    python tools/verify_plan.py --check    # CI gate (non-zero on defect)
+    python tools/verify_plan.py --stages 4 --micro 4 --devices 8
+
+Builds the standard MLP pipeline fixture (the same shape the fidelity
+report and tier-1 tests use), plans it, runs every static check, and
+prints the report. With ``--check`` it additionally plants one seeded
+corruption (an orphaned SEND) and fails unless the verifier rejects it —
+a self-test that the gate actually gates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+
+def build_fixture(stages: int, micro: int, devices: int):
+    """Plan the MLP fixture: (prog, dag, schedule)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tepdist_tpu.parallel.pipeline import plan_pipeline
+    from tepdist_tpu.runtime.execution_plan import build_pipeline_task_dag
+    from tepdist_tpu.runtime.task_scheduler import TaskScheduler
+
+    def loss_fn(params, x, y):
+        h = x
+        for w in params:
+            h = jnp.tanh(h @ w)
+        return jnp.mean((h - y) ** 2)
+
+    key = jax.random.PRNGKey(0)
+    n_layer, width, batch = 2 * stages, 16, 8 * micro
+    params = [jax.random.normal(jax.random.fold_in(key, i),
+                                (width, width)) * 0.1
+              for i in range(n_layer)]
+    x = jax.random.normal(jax.random.fold_in(key, 100), (batch, width))
+    y = jax.random.normal(jax.random.fold_in(key, 101), (batch, width))
+    prog = plan_pipeline(loss_fn, stages, micro, params, x, y)
+    ndev = min(devices, len(jax.devices()))
+    per = max(1, ndev // stages)
+    stage_devices = [tuple(range(s * per, (s + 1) * per))
+                     for s in range(stages)]
+    dag, _maps = build_pipeline_task_dag(prog, stage_devices)
+    schedule = TaskScheduler(dag).schedule()
+    return prog, dag, schedule
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--stages", type=int, default=2)
+    ap.add_argument("--micro", type=int, default=2)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--check", action="store_true",
+                    help="also verify a planted corruption is rejected; "
+                         "exit non-zero on any failure")
+    args = ap.parse_args()
+
+    from tepdist_tpu.analysis.plan_verify import (PlanVerificationError,
+                                                  verify_plan)
+
+    prog, dag, schedule = build_fixture(args.stages, args.micro,
+                                        args.devices)
+    try:
+        rep = verify_plan(dag, schedule=schedule, prog=prog,
+                          where="tools/verify_plan.py")
+    except PlanVerificationError as e:
+        print(f"FAIL: fixture plan rejected: {e}")
+        return 1
+    print(rep.summary())
+    for dev in sorted(rep.peak_bytes):
+        print(f"  dev {dev}: peak {rep.peak_bytes[dev] / 1e6:.2f} MB "
+              f"(limit {rep.hbm_limit_bytes / 1e9:.1f} GB)")
+
+    if args.check:
+        # Self-test: plant an orphaned SEND and require rejection.
+        _p2, dag2, sched2 = build_fixture(args.stages, args.micro,
+                                          args.devices)
+        from tepdist_tpu.runtime.task_graph import TaskType
+        send = next(n for n in dag2.nodes
+                    if n.task_type == TaskType.SEND)
+        recv = dag2.nodes[send.children[0]]
+        send.children.remove(recv.id)
+        recv.parents.remove(send.id)
+        recv.input_specs.pop(0, None)
+        try:
+            verify_plan(dag2, order=sched2.order)
+        except PlanVerificationError as e:
+            if e.kind != "orphan_send" or send.id not in e.tasks:
+                print(f"FAIL: planted orphan SEND misdiagnosed: {e}")
+                return 1
+            print(f"check: planted corruption rejected as expected "
+                  f"({e})")
+            return 0
+        print("FAIL: planted orphan SEND was NOT rejected")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
