@@ -692,6 +692,55 @@ def bench_serving(n_requests: int = 16, rounds: int = 3) -> dict:
     }
 
 
+def bench_paged_capacity() -> dict:
+    """Max resident requests at a FIXED emulated HBM budget: the KV
+    bytes a 2-slot x 32-token slot pool reserves, given instead to the
+    paged engine (16-token pages, per-request worst-case reservation).
+    Short requests pin a whole max_len row under slots but only
+    pages_for(T+max_new-1) pages under paging — the ratio is the
+    admission-capacity win the paged subsystem exists for.
+    Deterministic (counts, not timings): no spread guard."""
+    import numpy as np
+
+    from tepdist_tpu.models import gpt2
+    from tepdist_tpu.serving import ServingEngine
+    from tepdist_tpu.serving.paged_kv import page_bytes, pages_for
+
+    cfg = gpt2.CONFIGS["test"]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    slots, max_len, ps = 2, 32, 16
+    budget = pages_for(slots * max_len, ps) * page_bytes(cfg, ps)
+    residents = {}
+    for mode in ("slots", "paged"):
+        eng = ServingEngine(
+            params, cfg, kv_mode=mode, slots=slots, max_len=max_len,
+            page_size=ps, hbm_budget_bytes=(budget if mode == "paged"
+                                            else None),
+            max_queue=16, name=f"cap-{mode}")
+        rng = np.random.RandomState(0)
+        for i in range(8):
+            eng.submit(f"c{i}",
+                       rng.randint(0, cfg.vocab_size,
+                                   size=5).astype(np.int32),
+                       max_new_tokens=5)
+        eng.step()            # one admission wave at the same budget
+        st = eng.stats()
+        residents[mode] = (st["resident"] if mode == "paged"
+                           else st["slots_used"])
+        eng.run_until_idle()  # finish cleanly (also exercises decode)
+    ratio = (residents["paged"] / residents["slots"]
+             if residents["slots"] else None)
+    return {
+        "metric": "paged_capacity_x",
+        "value": round(ratio, 2) if ratio else None,
+        "unit": "x slot residents at equal HBM budget",
+        "hbm_budget_bytes": budget,
+        "slot_residents": residents["slots"],
+        "paged_residents": residents["paged"],
+        "gate_2x": bool(ratio and ratio >= 2.0),
+    }
+
+
 def _persist_tpu_headline(line: dict) -> None:
     """Record the last-good TPU headline with provenance so a future
     tunnel wedge degrades to a STALE-FLAGGED TPU number, never a CPU
@@ -809,6 +858,11 @@ def main() -> None:
             extra.append({"metric": "serving_tok_s", "error":
                           traceback.format_exc(limit=3).splitlines()[-1]})
         try:
+            extra.append(bench_paged_capacity())
+        except Exception:
+            extra.append({"metric": "paged_capacity_x", "error":
+                          traceback.format_exc(limit=3).splitlines()[-1]})
+        try:
             extra.append(bench_plan_verify())
         except Exception:
             extra.append({"metric": "plan_verify_ms", "error":
@@ -877,6 +931,7 @@ def main() -> None:
     selected = {
         "trace": bench_trace_overhead,   # ~ms; telemetry no-op guarantee
         "serving": bench_serving,        # continuous-batching decode tok/s
+        "paged": bench_paged_capacity,   # paged-vs-slots admission capacity
         "117m": lambda: bench_gpt2_117m(True),
         "runtime": bench_runtime_protocol,   # pinned protocol, every round
         "flash": bench_flash_attention_long,

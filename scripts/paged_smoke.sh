@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Paged-KV smoke: a timeout-bounded in-proc pass over the paged serving
+# substrate's three acceptance gates — run it locally or as a CI step.
+#
+#   1. BIT-IDENTITY: a mixed greedy batch (multi-chunk long prompt,
+#      page-boundary lengths, a prefix-cache hit) on the paged engine
+#      must match sequential sample() token-for-token.
+#   2. PREFIX CACHE: a --shared-prefix load (every request opens with
+#      the same 32-token system prompt, sized so requests queue behind
+#      the pool) must record prefix_hit_rate > 0 — shared spans served
+#      from cached pages, not re-prefilled.
+#   3. NO LEAKS: after the load drains, pages_used must be 0 (refcounts
+#      sum to zero; the prefix cache released its references).
+#
+# Override the per-pass bound with PAGED_SMOKE_TIMEOUT (seconds).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${PAGED_SMOKE_TIMEOUT:-600}"
+
+echo "=== paged smoke 1/2: greedy bit-identity vs sequential sample() ==="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu PYTHONPATH=. python - <<'EOF'
+import jax, numpy as np
+from tepdist_tpu.models import gpt2
+from tepdist_tpu.models.sampling import sample
+from tepdist_tpu.serving import ServingEngine
+
+cfg = gpt2.CONFIGS["test"]
+params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+eng = ServingEngine(params, cfg, kv_mode="paged", slots=4, max_len=64,
+                    name="paged-smoke")
+system = (np.arange(32, dtype=np.int32) * 11 + 5) % cfg.vocab_size
+prompts = [np.arange(40, dtype=np.int32) % cfg.vocab_size,        # 2 chunks
+           (np.arange(7, dtype=np.int32) * 3 + 1) % cfg.vocab_size,
+           np.concatenate([system, np.asarray([3, 1, 4], np.int32)]),
+           np.concatenate([system, np.asarray([1, 5, 9], np.int32)])]
+mnts = [6, 5, 4, 4]
+for i, (p, m) in enumerate(zip(prompts, mnts)):
+    # Sequential: request 3 must hit request 2's committed prefix.
+    assert eng.submit(f"r{i}", p, max_new_tokens=m)["status"] == "queued"
+    eng.run_until_idle()
+res = {r["request_id"]: r for r in eng.poll([f"r{i}" for i in range(4)])}
+for i, (p, m) in enumerate(zip(prompts, mnts)):
+    ref = np.asarray(sample(params, p[None], cfg, max_new_tokens=m,
+                            greedy=True))[0, len(p):]
+    got = np.asarray(res[f"r{i}"]["tokens"], np.int32)
+    assert (got == ref).all(), f"r{i}: {got} != {ref}"
+from tepdist_tpu.telemetry import metrics
+hits = metrics().snapshot()["counters"].get("prefix_hits", 0)
+assert hits >= 1, f"expected a prefix hit, got {hits}"
+eng.drain(wait_ms=0)
+st = eng.stats()
+assert st["pages_used"] == 0, st
+assert st["page_refs"] == 0, st
+print(f"bit-identity OK (4 requests, prefix_hits={hits}, "
+      f"pages_used={st['pages_used']} after drain)")
+EOF
+
+echo "=== paged smoke 2/2: shared-prefix load (hit rate + leak gate) ==="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu PYTHONPATH=. python - <<'EOF'
+import json
+from tools.serve_load import run_load
+
+s = run_load(requests=16, workers=2, slots=4, max_len=64,
+             shared_prefix=32, prompt_len=(3, 8), max_new=(2, 5),
+             kv_mode="paged")
+print(json.dumps({k: s[k] for k in
+                  ("statuses", "prefix_hits", "prefix_hit_rate",
+                   "prefix_hit_tokens", "prefill_chunks",
+                   "pages_used_after_drain")}, indent=1))
+assert s["statuses"].get("done") == 16, s["statuses"]
+assert s["prefix_hit_rate"] > 0, "no prefix hits under shared prefix"
+assert s["pages_used_after_drain"] == 0, "page leak after drain"
+EOF
+
+echo "paged smoke: PASS"

@@ -477,17 +477,37 @@ def verify_servable(cfg, *, slots: int, max_len: int,
                     buckets: Sequence[int],
                     hbm_limit_bytes: Optional[float] = None,
                     dtype_bytes: Optional[int] = None,
+                    kv_mode: str = "slots",
+                    page_size: Optional[int] = None,
+                    n_pages: Optional[int] = None,
                     where: str = "") -> None:
     """Static pre-load check for a serving plan: bucket shape sanity and
-    the KV-cache + weight HBM budget (slots x max_len x 2 x layers x
-    d_model), the serving analogue of the training peak-HBM gate. Gated
-    by the same ``TEPDIST_VERIFY_PLAN`` knob at the call site."""
-    if slots < 1:
+    the KV + weight HBM budget — slot mode counts slots x max_len token
+    rows, paged mode counts the page pool (n_pages x page_size tokens,
+    which must at least fit one max_len request). The serving analogue
+    of the training peak-HBM gate; gated by the same
+    ``TEPDIST_VERIFY_PLAN`` knob at the call site."""
+    if kv_mode not in ("slots", "paged"):
+        raise PlanVerificationError(
+            "servable", f"unknown kv_mode {kv_mode!r}")
+    if kv_mode == "slots" and slots < 1:
         raise PlanVerificationError(
             "servable", f"need at least one KV slot, got {slots}")
     if max_len < 1:
         raise PlanVerificationError(
             "servable", f"max_len must be positive, got {max_len}")
+    if kv_mode == "paged":
+        if page_size is None or page_size < 1:
+            raise PlanVerificationError(
+                "servable", f"paged KV needs a positive page_size, "
+                            f"got {page_size}")
+        min_pages = -(-max_len // page_size)
+        if n_pages is None or n_pages < min_pages:
+            raise PlanVerificationError(
+                "servable",
+                f"page pool of {n_pages} pages x {page_size} tokens "
+                f"cannot hold one max_len={max_len} request "
+                f"(needs >= {min_pages} pages)")
     bs = list(buckets)
     if not bs or sorted(bs) != bs or len(set(bs)) != len(bs):
         raise PlanVerificationError(
@@ -509,15 +529,22 @@ def verify_servable(cfg, *, slots: int, max_len: int,
             dtype_bytes = 4
     n_layer = int(getattr(cfg, "n_layer", 0))
     d_model = int(getattr(cfg, "d_model", getattr(cfg, "n_embd", 0)))
-    kv_bytes = 2.0 * slots * max_len * n_layer * d_model * dtype_bytes
+    if kv_mode == "paged":
+        # +1: physical page 0 is the reserved trash page.
+        kv_tokens = (n_pages + 1) * page_size
+        kv_what = f"{n_pages}+1 pages x {page_size} tokens"
+    else:
+        kv_tokens = slots * max_len
+        kv_what = f"{slots} slots x {max_len}"
+    kv_bytes = 2.0 * kv_tokens * n_layer * d_model * dtype_bytes
     vocab = int(getattr(cfg, "vocab_size", 0))
     weight_bytes = float(12 * n_layer * d_model * d_model
                          + vocab * d_model) * dtype_bytes
     if hbm_limit_bytes > 0 and kv_bytes + weight_bytes > hbm_limit_bytes:
         raise PlanVerificationError(
             "hbm_overflow",
-            f"servable KV cache ({kv_bytes / 1e9:.3f} GB = {slots} slots "
-            f"x {max_len} x 2 x {n_layer} layers x {d_model}) + weights "
+            f"servable KV cache ({kv_bytes / 1e9:.3f} GB = {kv_what} "
+            f"x 2 x {n_layer} layers x {d_model}) + weights "
             f"({weight_bytes / 1e9:.3f} GB) exceed HBM "
             f"{hbm_limit_bytes / 1e9:.3f} GB{' at ' + where if where else ''}")
     from tepdist_tpu.telemetry import metrics

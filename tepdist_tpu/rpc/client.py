@@ -279,13 +279,21 @@ class TepdistClient:
                       name: str = "servable",
                       max_restarts: int = 3,
                       shed_high: Optional[int] = None,
-                      shed_low: Optional[int] = None) -> str:
+                      shed_low: Optional[int] = None,
+                      kv_mode: str = "paged", page_size: int = 16,
+                      n_pages: Optional[int] = None,
+                      hbm_budget_bytes: Optional[float] = None,
+                      prefix_cache: bool = True,
+                      prefill_chunk: Optional[int] = None) -> str:
         """Ship a model (JSON-able GPT2Config dict + flat param leaves in
         tree_flatten order) and start its supervised serving engine.
         Returns the servable id used by the other serve verbs.
         ``max_restarts`` bounds supervised recovery; ``shed_high``/
         ``shed_low`` set the overload watermark (defaults: max_queue and
-        half of it)."""
+        half of it). ``kv_mode``/``page_size``/``n_pages``/
+        ``hbm_budget_bytes``/``prefix_cache``/``prefill_chunk`` pick the
+        KV substrate: block-paged with prefix sharing and chunked
+        prefill (default) or the fixed-slot fallback."""
         metas, blobs = [], []
         for leaf in param_leaves:
             meta, blob = protocol.encode_literal(np.asarray(leaf))
@@ -297,7 +305,11 @@ class TepdistClient:
             "buckets": list(buckets) if buckets is not None else None,
             "max_queue": int(max_queue), "name": name,
             "max_restarts": int(max_restarts),
-            "shed_high": shed_high, "shed_low": shed_low}, blobs)
+            "shed_high": shed_high, "shed_low": shed_low,
+            "kv_mode": kv_mode, "page_size": int(page_size),
+            "n_pages": n_pages, "hbm_budget_bytes": hbm_budget_bytes,
+            "prefix_cache": bool(prefix_cache),
+            "prefill_chunk": prefill_chunk}, blobs)
         header, _ = protocol.unpack(resp)
         return header["servable_id"]
 

@@ -1303,6 +1303,8 @@ class TepdistServicer:
         # KV-cache plan can't fit HBM before compiling anything.
         from tepdist_tpu.analysis.plan_verify import (verify_enabled,
                                                       verify_servable)
+        kv_mode = header.get("kv_mode", "paged")
+        page_size = int(header.get("page_size", 16))
         if verify_enabled():
             from tepdist_tpu.serving.kv_cache import default_buckets
             v_slots = int(header.get("slots", 4))
@@ -1310,8 +1312,16 @@ class TepdistServicer:
             v_buckets = sorted({min(int(b), v_max_len) for b in
                                 (header.get("buckets")
                                  or default_buckets(v_max_len))})
+            v_pages = None
+            if kv_mode == "paged":
+                from tepdist_tpu.serving.paged_kv import derive_n_pages
+                v_pages = derive_n_pages(
+                    cfg, page_size=page_size, max_len=v_max_len,
+                    slots=v_slots, n_pages=header.get("n_pages"),
+                    hbm_budget_bytes=header.get("hbm_budget_bytes"))
             verify_servable(cfg, slots=v_slots, max_len=v_max_len,
-                            buckets=v_buckets,
+                            buckets=v_buckets, kv_mode=kv_mode,
+                            page_size=page_size, n_pages=v_pages,
                             where=f"LoadServable@{self.task_index}")
         eng = ServingSupervisor(
             params, cfg, slots=int(header.get("slots", 4)),
@@ -1322,7 +1332,12 @@ class TepdistServicer:
             task_index=self.task_index,
             max_restarts=int(header.get("max_restarts", 3)),
             shed_high=header.get("shed_high"),
-            shed_low=header.get("shed_low"))
+            shed_low=header.get("shed_low"),
+            kv_mode=kv_mode, page_size=page_size,
+            n_pages=header.get("n_pages"),
+            hbm_budget_bytes=header.get("hbm_budget_bytes"),
+            prefix_cache=bool(header.get("prefix_cache", True)),
+            prefill_chunk=header.get("prefill_chunk"))
         eng.start()
         self.servables[sid] = eng
         log.info("LoadServable %s: %s", sid, eng.stats())

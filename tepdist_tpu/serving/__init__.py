@@ -4,9 +4,16 @@ Layers (bottom-up):
 
   * kv_cache.py — slot-based batched KV-cache pool + length-bucketed
     compiled prefill/decode executables (generalizes
-    models/sampling.py::init_cache to a fixed-capacity pool).
+    models/sampling.py::init_cache to a fixed-capacity pool; kept as
+    the ``kv_mode="slots"`` fallback).
+  * paged_kv.py — the DEFAULT KV substrate: block-paged pool
+    (refcounted 16-token pages + per-request page tables), a rolling-
+    hash prefix cache that lets shared-system-prompt requests skip
+    prefill, and page-indexed gather/scatter executables for chunked
+    prefill and batched paged decode.
   * engine.py  — request queue, admission control with deadlines, and
-    the Orca-style iteration-level batching scheduler.
+    the Orca-style iteration-level batching scheduler (chunked prefill
+    interleaves long prompts with decode under kv_mode="paged").
   * supervisor.py — ServingSupervisor: engine lifecycle + request
     journal; on an engine fault it rebuilds the engine and replays
     in-flight requests (greedy ones re-prefilled from prompt+prefix,
@@ -18,14 +25,20 @@ Layers (bottom-up):
     and failover past open/overloaded/draining replicas.
 """
 
-from tepdist_tpu.serving.kv_cache import (ServableModel, SlotPool,
-                                          bucket_for, default_buckets)
+from tepdist_tpu.serving.kv_cache import (KVFreeError, ServableModel,
+                                          SlotPool, bucket_for,
+                                          default_buckets)
+from tepdist_tpu.serving.paged_kv import (PageError, PagePool, PageTable,
+                                          PagedServableModel, PrefixCache,
+                                          derive_n_pages, pages_for)
 from tepdist_tpu.serving.engine import ServeRequest, ServingEngine, TERMINAL
 from tepdist_tpu.serving.supervisor import ServingSupervisor
 from tepdist_tpu.serving.client import ServeClient, ServeOverloadError
 
 __all__ = [
-    "ServableModel", "SlotPool", "bucket_for", "default_buckets",
+    "ServableModel", "SlotPool", "KVFreeError", "bucket_for",
+    "default_buckets", "PageError", "PagePool", "PageTable",
+    "PagedServableModel", "PrefixCache", "derive_n_pages", "pages_for",
     "ServeRequest", "ServingEngine", "TERMINAL", "ServingSupervisor",
     "ServeClient", "ServeOverloadError",
 ]

@@ -117,7 +117,11 @@ class ServeClient:
              buckets: Optional[Sequence[int]] = None,
              max_queue: int = 64, name: str = "servable",
              max_restarts: int = 3, shed_high: Optional[int] = None,
-             shed_low: Optional[int] = None) -> List[str]:
+             shed_low: Optional[int] = None, kv_mode: str = "paged",
+             page_size: int = 16, n_pages: Optional[int] = None,
+             hbm_budget_bytes: Optional[float] = None,
+             prefix_cache: bool = True,
+             prefill_chunk: Optional[int] = None) -> List[str]:
         """Install the model on every worker; returns per-worker ids."""
         spec = config_to_spec(cfg)
         leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(params)]
@@ -125,7 +129,12 @@ class ServeClient:
             (c, c.load_servable(spec, leaves, slots=slots, max_len=max_len,
                                 buckets=buckets, max_queue=max_queue,
                                 name=name, max_restarts=max_restarts,
-                                shed_high=shed_high, shed_low=shed_low))
+                                shed_high=shed_high, shed_low=shed_low,
+                                kv_mode=kv_mode, page_size=page_size,
+                                n_pages=n_pages,
+                                hbm_budget_bytes=hbm_budget_bytes,
+                                prefix_cache=prefix_cache,
+                                prefill_chunk=prefill_chunk))
             for c in self.clients]
         self.breakers = [_Breaker(self._breaker_threshold,
                                   self._breaker_cooldown_s)

@@ -14,6 +14,14 @@ scheduling (Yu et al., OSDI'22) over the slot pool in kv_cache.py:
     ONE batched decode step appending one token to every active request.
     New requests slip in between decode steps; a finished sequence frees
     its slot without stalling the rest of the batch.
+  * ``kv_mode="paged"`` (the default) swaps the slot pool for the
+    block-paged subsystem in paged_kv.py: admission reserves PAGES
+    (page_size tokens each) instead of a max_len slot — prefix-cache
+    hits attach to shared pages and skip that prefill span entirely —
+    and prompts prefill in page-aligned CHUNKS, one chunk per request
+    per scheduler iteration, interleaved with the batched decode so a
+    giant prompt never monopolizes an iteration. ``kv_mode="slots"``
+    keeps the original fixed-slot engine as a fallback.
   * ``start()`` runs ``step()`` on a daemon scheduler thread that idles
     on a condition variable when there is no work; tests that need
     lockstep determinism drive ``step()``/``run_until_idle()`` directly
@@ -33,8 +41,11 @@ scheduling (Yu et al., OSDI'22) over the slot pool in kv_cache.py:
 Telemetry (always-on metrics; spans when tracing is enabled):
 counters   serve_requests_{submitted,completed,rejected,expired,
            cancelled,deduped,failed}, serve_prefills, serve_decode_steps,
-           serve_tokens, serve_compiles
-gauges     serve_queue_depth, serve_slot_occupancy
+           serve_tokens, serve_compiles; paged: prefill_chunks,
+           serve_prefill_tokens, prefix_hits, prefix_hit_tokens,
+           prefix_evictions, pages_cow
+gauges     serve_queue_depth, serve_slot_occupancy; paged: pages_used,
+           pages_free, pages_cached
 histograms serve_ttft_ms, serve_token_ms, serve_batch_size
 spans      serve:ttft (submit -> first token, one per request),
            serve:prefill, serve:decode (one per step), serve:token (one
@@ -59,6 +70,7 @@ from tepdist_tpu.models.gpt2 import GPT2Config
 from tepdist_tpu.models.sampling import _split_data
 from tepdist_tpu.runtime import faults
 from tepdist_tpu.serving.kv_cache import ServableModel
+from tepdist_tpu.serving.paged_kv import PagedServableModel
 from tepdist_tpu.telemetry import metrics, span
 
 log = logging.getLogger("tepdist.serving")
@@ -93,6 +105,10 @@ class ServeRequest:
     ttft_span: Any = None
     decode_ms: float = 0.0           # summed batched-decode step time
     decode_steps: int = 0
+    table: Any = None                # paged_kv.PageTable (kv_mode=paged)
+    prefilled: int = 0               # prompt tokens whose k/v are cached
+    prefix_tokens: int = 0           # of those, tokens from a prefix hit
+    chunks: int = 0                  # prefill chunk executions
 
     def result(self) -> Dict[str, Any]:
         out = {
@@ -125,17 +141,38 @@ class ServingEngine:
                  max_queue: int = 64, name: str = "servable",
                  task_index: Optional[int] = None,
                  on_fault: Optional[Callable[[BaseException], None]]
-                 = None):
-        self.model = ServableModel(params, cfg, slots=slots,
-                                   max_len=max_len, buckets=buckets,
-                                   name=name)
+                 = None, kv_mode: str = "paged", page_size: int = 16,
+                 n_pages: Optional[int] = None,
+                 hbm_budget_bytes: Optional[float] = None,
+                 prefix_cache: bool = True,
+                 prefill_chunk: Optional[int] = None):
+        if kv_mode not in ("paged", "slots"):
+            raise ValueError(f"kv_mode must be 'paged' or 'slots', "
+                             f"got {kv_mode!r}")
+        self.kv_mode = kv_mode
+        if kv_mode == "paged":
+            # `slots` survives as the capacity hint: with no explicit
+            # n_pages/HBM budget the pool holds the same token count the
+            # slot pool would have (slots * max_len), just page-granular.
+            self.model: Any = PagedServableModel(
+                params, cfg, page_size=page_size, n_pages=n_pages,
+                hbm_budget_bytes=hbm_budget_bytes, slots=slots,
+                max_len=max_len, buckets=buckets,
+                prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
+                name=name)
+        else:
+            self.model = ServableModel(params, cfg, slots=slots,
+                                       max_len=max_len, buckets=buckets,
+                                       name=name)
         self.name = name
         self.max_queue = int(max_queue)
         self.task_index = task_index      # fault-rule ti filter target
         self.on_fault = on_fault          # set => supervised (ladder up)
         self._reqs: Dict[str, ServeRequest] = {}
         self._queue: deque = deque()
-        self._active: Dict[int, str] = {}        # slot -> rid
+        # Resident requests in admission order (paged decode batches it;
+        # slot mode orders its decode batch by slot id below).
+        self._active: Dict[str, ServeRequest] = {}
         self._cv = make_condition("ServingEngine._cv")
         self._thread: Optional[threading.Thread] = None
         self._stop = False
@@ -206,6 +243,22 @@ class ServingEngine:
             self._cv.notify_all()
             return {"status": "queued"}
 
+    def _release_locked(self, r: ServeRequest) -> None:
+        """Return a request's KV resources (slot or page table) to the
+        pool and drop it from the resident set. Idempotent per request:
+        the slot/table field is cleared so a second call is a no-op —
+        the pool itself raises ``KVFreeError`` on a true double free."""
+        if r.slot is not None:
+            self.model.pool.release(r.slot)
+            r.slot = None
+        if r.table is not None:
+            self.model.release_table(r.table)
+            r.table = None
+        self._active.pop(r.rid, None)
+        metrics().gauge("serve_slot_occupancy").set(
+            len(self._active) if self.kv_mode == "paged"
+            else self.model.pool.n_used)
+
     def cancel(self, rid: str) -> bool:
         """Cancel a queued or decoding request; terminal ones are left
         alone (their result already stands)."""
@@ -213,12 +266,7 @@ class ServingEngine:
             r = self._reqs.get(rid)
             if r is None or r.state in TERMINAL:
                 return False
-            if r.slot is not None:
-                self.model.pool.release(r.slot)
-                self._active.pop(r.slot, None)
-                r.slot = None
-                metrics().gauge("serve_slot_occupancy").set(
-                    self.model.pool.n_used)
+            self._release_locked(r)
             r.state = "cancelled"
             r.t_done = time.monotonic()
             metrics().counter("serve_requests_cancelled").inc()
@@ -288,8 +336,11 @@ class ServingEngine:
             raise faults.InjectedFault(
                 f"injected engine crash at scheduler step {self._steps} "
                 f"(worker {self.task_index})", kind="engine_crash")
+        paged = self.kv_mode == "paged"
         with self._cv:
-            while self._queue and self.model.pool.n_free:
+            while self._queue:
+                if not paged and not self.model.pool.n_free:
+                    break
                 rid = self._queue.popleft()
                 r = self._reqs.get(rid)
                 if r is None or r.state != "queued":
@@ -302,23 +353,51 @@ class ServingEngine:
                     m.counter("serve_requests_expired").inc()
                     self._cv.notify_all()
                     continue
-                r.slot = self.model.pool.alloc()
-                r.state = "active"
-                self._active[r.slot] = rid
+                if paged:
+                    # Reservation-based admission: attach() reserves every
+                    # page the request could need (after a prefix-cache
+                    # lookup and, on pressure, LRU eviction) so an
+                    # admitted request can never die of page exhaustion.
+                    # Head-of-line FIFO: if the head doesn't fit, nothing
+                    # behind it jumps the queue.
+                    att = self.model.attach(r.prompt, r.max_new_tokens)
+                    if att is None:
+                        self._queue.appendleft(rid)
+                        break
+                    r.table, r.prefix_tokens = att
+                    r.prefilled = r.prefix_tokens
+                    r.state = "prefill"
+                else:
+                    r.slot = self.model.pool.alloc()
+                    r.state = "active"
+                self._active[rid] = r
                 admitted.append(r)
             m.gauge("serve_queue_depth").set(len(self._queue))
             if admitted:
-                m.gauge("serve_slot_occupancy").set(self.model.pool.n_used)
+                m.gauge("serve_slot_occupancy").set(
+                    len(self._active) if paged
+                    else self.model.pool.n_used)
 
-        for r in admitted:
-            self._prefill_one(r)
+        if paged:
+            # One page-aligned chunk per prefilling request per iteration
+            # — long prompts interleave with the decode batch below
+            # instead of monopolizing the iteration.
+            with self._cv:
+                prefilling = [r for r in self._active.values()
+                              if r.state == "prefill"]
+            for r in prefilling:
+                self._prefill_chunk(r)
+        else:
+            for r in admitted:
+                self._prefill_one(r)
 
         with self._cv:
-            batch = [(slot, self._reqs[rid])
-                     for slot, rid in sorted(self._active.items())
-                     if self._reqs[rid].state == "active"]
+            batch = [r for r in self._active.values()
+                     if r.state == "active"]
+            if not paged:
+                batch.sort(key=lambda r: r.slot)
         if not batch:
-            return bool(admitted)
+            return bool(admitted) or (paged and bool(prefilling))
         self._decode_once(batch)
         return True
 
@@ -353,39 +432,137 @@ class ServingEngine:
                 self._finish_locked(r)
             self._cv.notify_all()
 
+    def _prefill_chunk(self, r: ServeRequest) -> None:
+        """Run ONE page-aligned prefill chunk for ``r`` (kv_mode=paged).
+        The final chunk's logits yield the request's first token, closing
+        the TTFT span — a prefix-cache hit skips straight to the tail, so
+        ``serve_prefill_tokens`` counts exactly the un-shared span."""
+        m = metrics()
+        plan = faults.active()
+        if plan is not None:
+            plan.serve_op("prefill", self.task_index)
+        T = int(r.prompt.size)
+        start = r.prefilled
+        end = min(start + self.model.chunk_tokens, T)
+        with self._cv:
+            if r.state != "prefill":
+                return                # cancelled since the batch snapshot
+            # Host-side page allocation under the lock; the executable
+            # below runs outside it like every other jax call here. The
+            # pages snapshot keeps a concurrent cancel's release_table
+            # from yanking the table mid-call (its stray writes land in
+            # pages only this thread could reallocate).
+            self.model.extend_table(r.table, end)
+            pages = list(r.table.pages)
+        with span("serve:prefill", cat="serve", rid=r.rid,
+                  chunk=end - start, start=start,
+                  prompt_len=T) as sp:
+            logits = self.model.prefill_chunk(pages, r.prompt,
+                                              start, end)
+            sp.set(chunks=r.chunks + 1)
+            tok = None
+            if end >= T:
+                sub = None
+                if not r.greedy:
+                    kd = jax.random.key_data(jax.random.PRNGKey(r.seed))
+                    r.kd, sub = _split_data(kd)
+                tok = self.model.pick(logits, sub, r.temperature,
+                                      r.top_k, r.greedy)
+        m.counter("prefill_chunks").inc()
+        m.counter("serve_prefill_tokens").inc(end - start)
+        with self._cv:
+            if r.state != "prefill":
+                return                # cancelled mid-chunk: drop it
+            r.prefilled = end
+            r.chunks += 1
+            if end < T:
+                return
+            # Prompt fully resident: publish its full pages for prefix
+            # sharing, emit the first token, and join the decode batch.
+            self.model.commit_prefix(r.prompt, r.table)
+            r.t_first = time.monotonic()
+            r.tokens.append(tok)
+            r.pos = T
+            r.state = "active"
+            m.counter("serve_prefills").inc()
+            m.counter("serve_tokens").inc()
+            m.histogram("serve_ttft_ms").observe(
+                (r.t_first - r.t_submit) * 1e3)
+            if r.ttft_span is not None:
+                r.ttft_span.__exit__(None, None, None)
+                r.ttft_span = None
+            if len(r.tokens) >= r.max_new_tokens:
+                self._finish_locked(r)
+            self._cv.notify_all()
+
     def _decode_once(self, batch) -> None:
         m = metrics()
         plan = faults.active()
         if plan is not None:
             plan.serve_op("decode", self.task_index)
-        S = self.model.n_slots
-        tok = np.zeros(S, np.int32)
-        pos = np.zeros(S, np.int32)
-        for slot, r in batch:
-            tok[slot] = r.tokens[-1]
-            pos[slot] = r.pos
+        paged = self.kv_mode == "paged"
+        slots: List[int] = []
+        if paged:
+            with self._cv:
+                batch = [r for r in batch if r.state == "active"]
+                if not batch:
+                    return
+                for r in batch:
+                    # Grow each table to cover this token's write and
+                    # COW-split a shared target page (structurally
+                    # unreachable — shared pages lie below the write
+                    # frontier — but the guard is load-bearing for any
+                    # future partial-page sharing).
+                    self.model.extend_table(r.table, r.pos + 1)
+                    self.model.ensure_writable(r.table, r.pos)
+                # Page-list snapshots: a cancel mid-decode releases the
+                # live table; freed pages can't be reallocated until this
+                # scheduler thread runs admission again.
+                rows = [(list(r.table.pages), r.tokens[-1], r.pos)
+                        for r in batch]
+        else:
+            S = self.model.n_slots
+            tok = np.zeros(S, np.int32)
+            pos = np.zeros(S, np.int32)
+            with self._cv:
+                # Snapshot slot ids under the lock: a concurrent cancel()
+                # sets r.slot = None mid-decode, and tok[None] = x is a
+                # numpy broadcast that would overwrite EVERY slot's token.
+                pairs = [(r.slot, r) for r in batch
+                         if r.state == "active" and r.slot is not None]
+            if not pairs:
+                return
+            slots = [s for s, _ in pairs]
+            batch = [r for _, r in pairs]
+            for s, r in pairs:
+                tok[s] = r.tokens[-1]
+                pos[s] = r.pos
         tok_spans = [span("serve:token", cat="serve", rid=r.rid)
-                     for _, r in batch]
+                     for r in batch]
         for sp in tok_spans:
             sp.__enter__()
         t0 = time.perf_counter()
         with span("serve:decode", cat="serve", batch=len(batch)):
-            logits = self.model.decode_step(tok, pos)
+            if paged:
+                logits = self.model.decode_batch(rows)
+            else:
+                logits = self.model.decode_step(tok, pos)
             logits.block_until_ready()
         step_ms = (time.perf_counter() - t0) * 1e3
         picked = []
-        for slot, r in batch:
+        for i, r in enumerate(batch):
             sub = None
             if not r.greedy:
                 r.kd, sub = _split_data(r.kd)
-            picked.append(self.model.pick(logits[slot], sub,
-                                          r.temperature, r.top_k, r.greedy))
+            row = logits[i] if paged else logits[slots[i]]
+            picked.append(self.model.pick(row, sub, r.temperature,
+                                          r.top_k, r.greedy))
         for sp in tok_spans:
             sp.__exit__(None, None, None)
         m.counter("serve_decode_steps").inc()
         m.histogram("serve_batch_size").observe(len(batch))
         with self._cv:
-            for (slot, r), tok_i in zip(batch, picked):
+            for r, tok_i in zip(batch, picked):
                 if r.state != "active":
                     continue          # cancelled mid-step: drop the token
                 r.tokens.append(tok_i)
@@ -399,17 +576,24 @@ class ServingEngine:
             self._cv.notify_all()
 
     def _finish_locked(self, r: ServeRequest) -> None:
-        if r.slot is not None:
-            self.model.pool.release(r.slot)
-            self._active.pop(r.slot, None)
-            r.slot = None
+        self._release_locked(r)
         r.state = "done"
         r.t_done = time.monotonic()
         m = metrics()
         m.counter("serve_requests_completed").inc()
-        m.gauge("serve_slot_occupancy").set(self.model.pool.n_used)
         m.histogram("serve_request_ms").observe(
             (r.t_done - r.t_submit) * 1e3)
+        if (self._draining and not self._active
+                and self.kv_mode == "paged"):
+            self._clear_prefix_locked()
+
+    def _clear_prefix_locked(self) -> None:
+        """Drop prefix-cache page references once a drain has retired
+        every resident request — the no-page-leaks contract is
+        ``pages_used == 0`` after drain, cache included."""
+        if getattr(self.model, "prefix", None) is not None:
+            self.model.prefix.clear()
+            self.model._update_gauges()
 
     def _fail_all_locked(self, err: str) -> None:
         """The LAST rung of the fault ladder: every non-terminal request
@@ -420,10 +604,7 @@ class ServingEngine:
         for r in self._reqs.values():
             if r.state in TERMINAL:
                 continue
-            if r.slot is not None:
-                self.model.pool.release(r.slot)
-                self._active.pop(r.slot, None)
-                r.slot = None
+            self._release_locked(r)
             if r.ttft_span is not None:
                 r.ttft_span.__exit__(None, None, None)
                 r.ttft_span = None
@@ -432,8 +613,9 @@ class ServingEngine:
             r.t_done = time.monotonic()
             m.counter("serve_requests_failed").inc()
         self._queue.clear()
+        if self.kv_mode == "paged":
+            self._clear_prefix_locked()
         m.gauge("serve_queue_depth").set(0)
-        m.gauge("serve_slot_occupancy").set(self.model.pool.n_used)
         self._cv.notify_all()
 
     # -- drain ----------------------------------------------------------
@@ -447,6 +629,25 @@ class ServingEngine:
         ``run_until_idle()`` themselves."""
         m = metrics()
         handed: List[Dict[str, Any]] = []
+
+        def _hand_back(r: ServeRequest) -> None:
+            if r.ttft_span is not None:
+                r.ttft_span.__exit__(None, None, None)
+                r.ttft_span = None
+            r.state = "drained"
+            r.t_done = time.monotonic()
+            handed.append({
+                "request_id": r.rid,
+                "prompt": [int(t) for t in r.prompt],
+                "max_new_tokens": r.max_new_tokens,
+                "greedy": r.greedy,
+                "temperature": r.temperature,
+                "top_k": r.top_k,
+                "seed": r.seed,
+                "deadline_ms": r.deadline_ms,
+            })
+            m.counter("drain_handoffs").inc()
+
         with self._cv:
             self._draining = True
             while self._queue:
@@ -454,22 +655,16 @@ class ServingEngine:
                 r = self._reqs.get(rid)
                 if r is None or r.state != "queued":
                     continue
-                if r.ttft_span is not None:
-                    r.ttft_span.__exit__(None, None, None)
-                    r.ttft_span = None
-                r.state = "drained"
-                r.t_done = time.monotonic()
-                handed.append({
-                    "request_id": r.rid,
-                    "prompt": [int(t) for t in r.prompt],
-                    "max_new_tokens": r.max_new_tokens,
-                    "greedy": r.greedy,
-                    "temperature": r.temperature,
-                    "top_k": r.top_k,
-                    "seed": r.seed,
-                    "deadline_ms": r.deadline_ms,
-                })
-                m.counter("drain_handoffs").inc()
+                _hand_back(r)
+            # Paged: a partially-prefilled request has emitted NO tokens
+            # yet (its first token appears only when the last chunk
+            # lands), so it is still a clean resubmittable spec — hand it
+            # back rather than burning drain budget finishing its prefill
+            # plus a full decode.
+            for r in [q for q in self._active.values()
+                      if q.state == "prefill"]:
+                self._release_locked(r)
+                _hand_back(r)
             m.gauge("serve_queue_depth").set(0)
             self._cv.notify_all()
             deadline = time.monotonic() + wait_ms / 1e3
@@ -478,6 +673,8 @@ class ServingEngine:
                 if remaining <= 0:
                     break
                 self._cv.wait(remaining)
+            if not self._active and self.kv_mode == "paged":
+                self._clear_prefix_locked()
         return handed
 
     def run_until_idle(self, max_steps: int = 100000) -> None:
@@ -557,10 +754,9 @@ class ServingEngine:
             states: Dict[str, int] = {}
             for r in self._reqs.values():
                 states[r.state] = states.get(r.state, 0) + 1
-            return {
+            out = {
                 "name": self.name,
-                "slots": self.model.n_slots,
-                "slots_used": self.model.pool.n_used,
+                "kv_mode": self.kv_mode,
                 "max_len": self.model.max_len,
                 "buckets": list(self.model.buckets),
                 "queue_depth": len(self._queue),
@@ -569,3 +765,23 @@ class ServingEngine:
                 "dead": self._dead,
                 "scheduler_steps": self._steps,
             }
+            if self.kv_mode == "paged":
+                out.update({
+                    "page_size": self.model.page_size,
+                    "pages": self.model.n_pages,
+                    "pages_used": self.model.pool.n_used,
+                    "pages_free": self.model.pool.n_free,
+                    "pages_reserved": self.model.pool.reserved,
+                    "page_refs": self.model.pool.refs_total(),
+                    "pages_cached": (len(self.model.prefix)
+                                     if self.model.prefix is not None
+                                     else 0),
+                    "prefill_chunk": self.model.chunk_tokens,
+                    "resident": len(self._active),
+                })
+            else:
+                out.update({
+                    "slots": self.model.n_slots,
+                    "slots_used": self.model.pool.n_used,
+                })
+            return out

@@ -50,6 +50,13 @@ from tepdist_tpu.telemetry import metrics
 _NEG_INF = sampling._NEG_INF
 
 
+class KVFreeError(ValueError):
+    """Typed double-free / bad-free of a KV-cache resource. Raised by
+    ``SlotPool.release`` and mirrored by ``paged_kv.PagePool`` decref —
+    a double release would otherwise silently corrupt the free list and
+    hand the same cache row to two requests."""
+
+
 def config_to_spec(cfg: GPT2Config) -> Dict[str, Any]:
     """JSON-able GPT2Config for the LoadServable wire header."""
     d = dataclasses.asdict(cfg)
@@ -69,7 +76,18 @@ def config_from_spec(spec: Dict[str, Any]) -> GPT2Config:
 
 
 def default_buckets(max_len: int, min_bucket: int = 8) -> List[int]:
-    """Power-of-two prompt-length buckets up to ``max_len`` (inclusive)."""
+    """Power-of-two prompt-length buckets up to ``max_len`` (inclusive).
+
+    Boundary contract (these buckets also pick chunked-prefill shapes):
+    ``max_len`` is always the last bucket, even when it is below
+    ``min_bucket`` or not a power of two; a prompt exactly at a bucket
+    length maps to that bucket (no pad)."""
+    if max_len < 1:
+        raise ValueError(f"max_len must be positive, got {max_len}")
+    if min_bucket < 1:
+        # b *= 2 from 0 or a negative never reaches max_len: the old
+        # code looped forever here instead of failing.
+        raise ValueError(f"min_bucket must be positive, got {min_bucket}")
     out = []
     b = min_bucket
     while b < max_len:
@@ -80,6 +98,14 @@ def default_buckets(max_len: int, min_bucket: int = 8) -> List[int]:
 
 
 def bucket_for(length: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= length; a length exactly at a bucket gets that
+    bucket. Empty bucket lists and non-positive lengths are caller bugs
+    and raise instead of surfacing as a confusing max()/pad error."""
+    if not buckets:
+        raise ValueError("bucket_for: empty bucket list")
+    if length < 1:
+        raise ValueError(f"bucket_for: length must be positive, "
+                         f"got {length}")
     for b in buckets:
         if length <= b:
             return b
@@ -100,8 +126,15 @@ class SlotPool:
         return self._free.pop() if self._free else None
 
     def release(self, slot: int) -> None:
+        """Return a slot to the pool. A double release (or a slot id the
+        pool never owned) raises the typed ``KVFreeError`` rather than
+        corrupting the free list — the engine treats it as a bug, never
+        retries it."""
+        if not 0 <= slot < self.n_slots:
+            raise KVFreeError(f"slot {slot} outside pool "
+                              f"[0, {self.n_slots})")
         if slot in self._free:
-            raise ValueError(f"slot {slot} double-released")
+            raise KVFreeError(f"slot {slot} double-released")
         self._free.append(slot)
 
     @property

@@ -94,12 +94,24 @@ class ServingSupervisor:
                  task_index: Optional[int] = None,
                  max_restarts: int = 3,
                  shed_high: Optional[int] = None,
-                 shed_low: Optional[int] = None):
+                 shed_low: Optional[int] = None,
+                 kv_mode: str = "paged", page_size: int = 16,
+                 n_pages: Optional[int] = None,
+                 hbm_budget_bytes: Optional[float] = None,
+                 prefix_cache: bool = True,
+                 prefill_chunk: Optional[int] = None):
         self._params = params
         self._cfg = cfg
+        # A rebuilt engine gets the SAME paged-KV geometry, so replay
+        # rebuilds page tables (and re-attaches prefix hits as replayed
+        # prompts re-commit their pages) on an identically-shaped pool.
         self._engine_kwargs = dict(slots=slots, max_len=max_len,
                                    buckets=buckets, max_queue=max_queue,
-                                   name=name)
+                                   name=name, kv_mode=kv_mode,
+                                   page_size=page_size, n_pages=n_pages,
+                                   hbm_budget_bytes=hbm_budget_bytes,
+                                   prefix_cache=prefix_cache,
+                                   prefill_chunk=prefill_chunk)
         self.name = name
         self.task_index = task_index
         self.max_restarts = int(max_restarts)

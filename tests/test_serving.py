@@ -183,11 +183,46 @@ def test_cancel_queued_and_active(params):
     np.testing.assert_array_equal(np.asarray(res["c"]["tokens"]), ref)
 
 
+def test_slot_cancel_mid_decode_does_not_corrupt_batch(params):
+    """Regression: slot-mode _decode_once must use slot ids snapshotted
+    under the lock. A cancel() landing between the scheduler's batch
+    snapshot and the decode step sets r.slot = None, and a live read
+    turns ``tok[r.slot] = x`` into a numpy broadcast that overwrites
+    EVERY slot's decode input — corrupting all other requests' tokens
+    for that step."""
+    eng = ServingEngine(params, CFG, slots=2, max_len=32,
+                        kv_mode="slots")
+    pa = np.arange(1, 7, dtype=np.int32)
+    pb = np.arange(2, 9, dtype=np.int32)
+    eng.submit("a", pa, max_new_tokens=6)
+    eng.submit("b", pb, max_new_tokens=6)
+    eng.step()                       # both resident + one decode step
+    with eng._cv:
+        batch = sorted((r for r in eng._active.values()
+                        if r.state == "active"),
+                       key=lambda r: r.slot)
+    assert len(batch) == 2
+    # Cancel the LATER slot: the broadcast lands after the survivor's
+    # entry was written, so a live-slot read would clobber it.
+    assert eng.cancel("b")           # b.slot -> None before the decode
+    eng._decode_once(batch)          # must skip b, decode a untouched
+    eng.run_until_idle()
+    res = {r["request_id"]: r for r in eng.poll()}
+    assert res["b"]["status"] == "cancelled"
+    assert res["a"]["status"] == "done"
+    ref = np.asarray(sample(params, pa[None], CFG, max_new_tokens=6,
+                            greedy=True))[0, len(pa):]
+    np.testing.assert_array_equal(np.asarray(res["a"]["tokens"]), ref)
+
+
 def test_step_failure_releases_slots_and_engine_survives(params):
     """Slot-leak regression: an UNSUPERVISED engine whose step dies
     mid-decode fails every in-flight request, returns ALL their slots to
-    the pool, and keeps serving new submissions at full capacity."""
-    eng = ServingEngine(params, CFG, slots=2, max_len=32)
+    the pool, and keeps serving new submissions at full capacity.
+    Pinned to kv_mode="slots" — the occupancy arithmetic here is
+    slot-specific; the paged analogue lives in test_serving_paged.py."""
+    eng = ServingEngine(params, CFG, slots=2, max_len=32,
+                        kv_mode="slots")
     p = np.arange(1, 6, dtype=np.int32)
     for i in range(3):
         assert eng.submit(f"r{i}", p, max_new_tokens=4)["status"] \

@@ -92,9 +92,12 @@ def test_serving_chaos_exactly_once_bit_identical(params):
     before = _counters()
     try:
         sc.load(params, CFG, slots=2, max_len=32, name="chaos")
+        # step=3 (not 5): the paged pool fits all of worker 1's requests
+        # in ONE admission wave (a page each), so its decode count per
+        # wave is lower than the slot engine's two-wave schedule.
         faults.configure(
             "engine_crash:step=3,ti=0;"
-            "serve_fault:op=decode,step=5,ti=1,seed=11")
+            "serve_fault:op=decode,step=3,ti=1,seed=11")
         rids = [sc.submit(p, max_new_tokens=m)["request_id"]
                 for p, m in zip(prompts, mnts)]
         results = sc.wait(rids, timeout_s=300)

@@ -1,0 +1,603 @@
+"""Paged KV-cache subsystem tests (tepdist_tpu/serving/paged_kv.py and
+the engine's paged scheduling path).
+
+Covers the ISSUE acceptance gates: greedy outputs on the paged engine
+bit-identical to sequential ``sample()`` AND to the slot engine
+(including multi-chunk prefills and prefix-cache hits); prefix hits
+provably skipping the prefill executable for the shared span
+(counter-verified); chunked prefill interleaving with decode so a short
+request's TTFT does not wait behind a long prompt; zero page leaks after
+drain (pages_used == 0, refcounts sum to zero); drain handing a
+partially-prefilled request back as a resubmittable spec; a supervisor
+crash mid-chunked-prefill replaying exactly once bit-identically; and
+the paged engine admitting >= 2x the slot baseline's residents at the
+same emulated HBM budget.
+
+Plus the allocator/bucket edges that ride along: PagePool refcounts,
+reservations, and typed double-free (``KVFreeError``, shared with
+``SlotPool.release``); PrefixCache chained-hash hits, LRU leaf-first
+eviction, and clear(); ``bucket_for``/``default_buckets`` boundary
+contracts; and the paged arm of ``verify_servable``.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tepdist_tpu import telemetry
+from tepdist_tpu.analysis.plan_verify import (PlanVerificationError,
+                                              verify_servable)
+from tepdist_tpu.models import gpt2
+from tepdist_tpu.models.sampling import sample
+from tepdist_tpu.runtime import faults
+from tepdist_tpu.serving import ServingEngine, ServingSupervisor
+from tepdist_tpu.serving.kv_cache import (KVFreeError, SlotPool,
+                                          bucket_for, default_buckets)
+from tepdist_tpu.serving.paged_kv import (PagedServableModel, PageError,
+                                          PagePool, PrefixCache,
+                                          _pow2_bucket, derive_n_pages,
+                                          page_bytes, pages_for)
+
+pytestmark = pytest.mark.serving
+
+CFG = gpt2.CONFIGS["test"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _counters():
+    return dict(telemetry.metrics().snapshot()["counters"])
+
+
+# One warm PagedServableModel per (page_size, max_len, n_pages)
+# geometry: later engines adopt its compiled executables (the same
+# supervisor-restart path production uses), so the suite pays each
+# XLA compile once instead of once per test.
+_WARM = {}
+
+
+def _adopt(engine):
+    m = getattr(engine, "model", engine)
+    if hasattr(m, "page_size"):
+        key = ("paged", m.page_size, m.max_len, m.n_pages)
+    else:
+        key = ("slots", m.n_slots, m.max_len)
+    prev = _WARM.get(key)
+    if prev is not None:
+        m.adopt_executables(prev)
+    _WARM[key] = m
+    return engine
+
+
+def _ref_tokens(params, prompt, max_new):
+    return np.asarray(sample(params, np.asarray(prompt, np.int32)[None],
+                             CFG, max_new_tokens=max_new,
+                             greedy=True))[0, len(prompt):]
+
+
+def _run_mix(engine, prompts, mnts):
+    rids = [f"r{i}" for i in range(len(prompts))]
+    for rid, p, m in zip(rids, prompts, mnts):
+        out = engine.submit(rid, p, max_new_tokens=m, greedy=True)
+        assert out["status"] == "queued", out
+    engine.run_until_idle()
+    return {r["request_id"]: r for r in engine.poll(rids)}
+
+
+# ---------------------------------------------------------------------------
+# PagePool: refcounts, reservations, typed double-free
+# ---------------------------------------------------------------------------
+
+def test_pages_for_and_pow2_bucket():
+    assert pages_for(0, 16) == 0
+    assert pages_for(1, 16) == 1
+    assert pages_for(16, 16) == 1
+    assert pages_for(17, 16) == 2
+    assert _pow2_bucket(1, 64) == 1
+    assert _pow2_bucket(3, 64) == 4
+    assert _pow2_bucket(4, 64) == 4
+    assert _pow2_bucket(100, 64) == 64      # clamped to the pool size
+
+
+def test_derive_n_pages_priority():
+    # Explicit n_pages wins over everything.
+    assert derive_n_pages(CFG, page_size=16, max_len=64, slots=2,
+                          n_pages=7, hbm_budget_bytes=1e12) == 7
+    # HBM budget: bytes // page_bytes.
+    pb = page_bytes(CFG, 16)
+    assert derive_n_pages(CFG, page_size=16, max_len=32,
+                          hbm_budget_bytes=6 * pb) == 6
+    # Slot-compat fallback: slots * max_len tokens.
+    assert derive_n_pages(CFG, page_size=16, max_len=32, slots=3) == 6
+    # Floor: one max_len request must always fit.
+    assert derive_n_pages(CFG, page_size=16, max_len=64, n_pages=1) == 4
+
+
+def test_page_pool_alloc_refcount_free():
+    pool = PagePool(4, 16)
+    assert pool.n_free == 4 and pool.n_used == 0
+    a = pool.alloc(2)
+    assert a == [1, 2]                      # low ids first (hot reuse)
+    assert pool.n_used == 2 and pool.refcount(1) == 1
+    pool.incref(1)
+    assert pool.refcount(1) == 2
+    assert pool.decref(1) is False          # still referenced
+    assert pool.n_used == 2
+    assert pool.decref(1) is True           # freed at zero
+    assert pool.n_used == 1 and pool.refcount(1) == 0
+    assert pool.alloc(1) == [1]             # freed page comes back first
+    pool.free_pages([1, 2])
+    assert pool.n_used == 0 and pool.refs_total() == 0
+
+
+def test_page_pool_double_free_is_typed():
+    pool = PagePool(2, 16)
+    (p,) = pool.alloc(1)
+    pool.decref(p)
+    with pytest.raises(KVFreeError, match="double-freed"):
+        pool.decref(p)
+    with pytest.raises(KVFreeError):
+        pool.decref(2)                      # never allocated
+    # Same typed error family as SlotPool.release (shared guard).
+    assert issubclass(KVFreeError, ValueError)
+    with pytest.raises(PageError):
+        pool.incref(2)
+
+
+def test_page_pool_reservations():
+    pool = PagePool(4, 16)
+    assert pool.reserve(3) is True
+    assert pool.available == 1 and pool.n_free == 4
+    with pytest.raises(PageError, match="exhausted"):
+        pool.alloc(2)                       # only 1 un-reserved page
+    got = pool.alloc(2, reserved=True)      # draws down the reservation
+    assert len(got) == 2 and pool.reserved == 1
+    assert pool.reserve(2) is False         # 2 free, 1 still reserved
+    pool.unreserve(1)
+    with pytest.raises(PageError, match="unreserve"):
+        pool.unreserve(1)
+    with pytest.raises(PageError, match="reservation"):
+        pool.alloc(1, reserved=True)
+
+
+def test_slot_pool_release_typed_error():
+    # Regression: release used to append blindly — a double release (or
+    # an out-of-range id) silently corrupted the LIFO free list and two
+    # requests could share one cache row.
+    pool = SlotPool(2)
+    s0 = pool.alloc()
+    pool.release(s0)
+    with pytest.raises(KVFreeError, match="double-released"):
+        pool.release(s0)
+    with pytest.raises(KVFreeError, match="outside pool"):
+        pool.release(5)
+    with pytest.raises(KVFreeError, match="outside pool"):
+        pool.release(-1)
+    assert pool.n_free == 2
+
+
+# ---------------------------------------------------------------------------
+# bucket boundary contracts
+# ---------------------------------------------------------------------------
+
+def test_default_buckets_boundaries():
+    assert default_buckets(64) == [8, 16, 32, 64]
+    assert default_buckets(16) == [8, 16]   # max_len == a pow2: no dup
+    assert default_buckets(8) == [8]
+    assert default_buckets(6) == [6]        # below min_bucket: still last
+    assert default_buckets(1) == [1]
+    assert default_buckets(5, min_bucket=8) == [5]
+    with pytest.raises(ValueError, match="max_len"):
+        default_buckets(0)
+    with pytest.raises(ValueError, match="min_bucket"):
+        # min_bucket <= 0 used to loop forever (b *= 2 from 0).
+        default_buckets(64, min_bucket=0)
+
+
+def test_bucket_for_edges():
+    assert bucket_for(8, [8, 16]) == 8      # exact boundary: no pad
+    assert bucket_for(9, [8, 16]) == 16
+    assert bucket_for(1, [8, 16]) == 8
+    with pytest.raises(ValueError, match="empty"):
+        bucket_for(4, [])
+    with pytest.raises(ValueError, match="positive"):
+        bucket_for(0, [8, 16])
+    with pytest.raises(ValueError, match="exceeds"):
+        bucket_for(17, [8, 16])
+
+
+# ---------------------------------------------------------------------------
+# PrefixCache: chained-hash hits, LRU leaf-first eviction
+# ---------------------------------------------------------------------------
+
+def test_prefix_cache_hit_and_leaf_first_eviction():
+    pool = PagePool(6, 4)
+    cache = PrefixCache(pool)
+    prompt = np.arange(12, dtype=np.int32)          # 3 full chunks of 4
+    pages = pool.alloc(3)
+    assert cache.insert(prompt, pages) == 3
+    assert all(pool.refcount(p) == 2 for p in pages)
+    assert cache.insert(prompt, pages) == 0          # idempotent
+    assert cache.lookup(prompt) == pages
+    assert cache.lookup(prompt[:9]) == pages[:2]     # whole chunks only
+    other = prompt.copy()
+    other[0] += 1                                    # first chunk differs
+    assert cache.lookup(other) == []                 # chained digest
+    # Request retires: cache alone holds the pages now.
+    pool.free_pages(pages)
+    assert all(pool.refcount(p) == 1 for p in pages)
+    # Eviction is leaf-first: the chain's tail goes before its parents.
+    assert cache.evict(1) == 1
+    assert len(cache) == 2 and pool.refcount(pages[2]) == 0
+    assert cache.lookup(prompt) == pages[:2]
+    cache.clear()
+    assert len(cache) == 0 and pool.n_used == 0
+
+
+def test_prefix_cache_evict_spares_shared_pages():
+    pool = PagePool(4, 4)
+    cache = PrefixCache(pool)
+    prompt = np.arange(8, dtype=np.int32)
+    pages = pool.alloc(2)
+    cache.insert(prompt, pages)
+    # A live request still references both pages: nothing is evictable.
+    assert cache.evict(2) == 0
+    assert len(cache) == 2
+    pool.free_pages(pages)
+    assert cache.evict(2) == 2
+    assert pool.n_used == 0
+
+
+# ---------------------------------------------------------------------------
+# PagedServableModel: attach/reserve/commit/COW host bookkeeping
+# ---------------------------------------------------------------------------
+
+def test_attach_reserves_worst_case_and_releases_clean(params):
+    model = PagedServableModel(params, CFG, page_size=4, n_pages=8,
+                               max_len=32, name="unit")
+    prompt = np.arange(10, dtype=np.int32) % CFG.vocab_size
+    att = model.attach(prompt, max_new=3)
+    assert att is not None
+    table, h = att
+    assert h == 0 and table.pages == []
+    # Worst case: prompt + max_new - 1 = 12 tokens -> 3 pages, all
+    # reserved up front so the request can never die of exhaustion.
+    assert table.reserved == 3 and model.pool.reserved == 3
+    model.extend_table(table, 10)
+    assert len(table.pages) == 3 and table.reserved == 0
+    with pytest.raises(PageError, match="underflow"):
+        model.extend_table(table, 14)        # beyond the reservation
+    model.release_table(table)
+    assert model.pool.n_used == 0 and model.pool.reserved == 0
+    # Admission failure is a clean None (caller re-queues), not a raise.
+    big = model.attach(np.arange(30, dtype=np.int32), max_new=3)
+    assert big is not None
+    assert model.attach(np.arange(30, dtype=np.int32), max_new=3) is None
+    model.release_table(big[0])
+    assert model.pool.n_used == 0
+
+
+def test_attach_under_pressure_spares_its_own_hit_chain(params):
+    """Regression: attach() must pin (incref) the prefix pages it just
+    looked up BEFORE pressure-triggered eviction runs. The old order let
+    evict()'s leaf-first walk free the very chain being attached (children
+    counters unblock parents as leaves go), and the subsequent incref
+    raised PageError — a step() crash on a legitimate shared-prefix
+    workload under memory pressure."""
+    model = PagedServableModel(params, CFG, page_size=4, n_pages=8,
+                               max_len=32, name="unit-pressure")
+    prompt = np.arange(16, dtype=np.int32) % CFG.vocab_size  # 4 pages
+    t1, h1 = model.attach(prompt, max_new=8)
+    assert h1 == 0
+    model.extend_table(t1, 16)
+    model.commit_prefix(prompt, t1)
+    model.release_table(t1)
+    assert len(model.prefix) == 4 and model.pool.n_used == 4
+
+    # A competing resident holds 3 pages -> 1 free. Re-attaching the
+    # cached prompt wants 3 fresh pages, so eviction demand (2) exceeds
+    # the single evictable non-hit leaf and the walk reaches the hit
+    # chain itself. Must decline cleanly, never raise.
+    held = model.pool.alloc(3)
+    assert model.attach(prompt, max_new=8) is None
+    assert model.pool.reserved == 0
+    cached = model.prefix.lookup(prompt)
+    assert len(cached) == 3          # only the non-hit leaf was evicted
+    assert all(model.pool.refcount(p) == 1 for p in cached)
+
+    # Pressure gone: the surviving chain attaches normally.
+    model.pool.free_pages(held)
+    t2, h2 = model.attach(prompt, max_new=8)
+    assert h2 == 12 and t2.n_shared == 3
+    assert all(model.pool.refcount(p) == 2 for p in t2.pages[:3])
+    model.release_table(t2)
+    model.prefix.clear()
+    assert model.pool.n_used == 0 and model.pool.refs_total() == 0
+
+
+def test_prefix_attach_cap_and_copy_on_write(params):
+    model = PagedServableModel(params, CFG, page_size=4, n_pages=8,
+                               max_len=32, name="unit-cow")
+    prompt = np.arange(8, dtype=np.int32)            # 2 full pages
+    t1, h1 = model.attach(prompt, max_new=2)
+    assert h1 == 0
+    model.extend_table(t1, 9)                        # covers T+max_new-1
+    model.commit_prefix(prompt, t1)
+    model.release_table(t1)
+    cached = model.prefix.lookup(prompt)
+    assert len(cached) == 2
+    assert all(model.pool.refcount(p) == 1 for p in cached)
+
+    before = _counters()
+    t2, h2 = model.attach(prompt, max_new=2)
+    # Hit capped at (T-1)//ps pages: the prompt's LAST token always
+    # re-prefills (its logits seed the first generated token).
+    assert h2 == 4 and t2.n_shared == 1
+    assert t2.pages == [cached[0]]
+    assert model.pool.refcount(cached[0]) == 2
+    d = _counters()
+    assert d.get("prefix_hits", 0) - before.get("prefix_hits", 0) == 1
+    assert (d.get("prefix_hit_tokens", 0)
+            - before.get("prefix_hit_tokens", 0)) == 4
+
+    model.extend_table(t2, 9)
+    # COW guard: a write aimed at the shared page forks it first.
+    model.ensure_writable(t2, 2)
+    after = _counters()
+    assert after.get("pages_cow", 0) - d.get("pages_cow", 0) == 1
+    assert t2.pages[0] != cached[0] and t2.n_shared == 0
+    assert model.pool.refcount(cached[0]) == 1       # cache's own ref
+    assert model.pool.refcount(t2.pages[0]) == 1
+    model.ensure_writable(t2, 2)                     # private now: no-op
+    assert _counters().get("pages_cow", 0) == after.get("pages_cow", 0)
+    model.release_table(t2)
+    model.prefix.clear()
+    assert model.pool.n_used == 0 and model.pool.refs_total() == 0
+
+
+# ---------------------------------------------------------------------------
+# Paged engine: bit-identity, chunked prefill, prefix hits, drain
+# ---------------------------------------------------------------------------
+
+def test_paged_engine_bit_identical_vs_sample_and_slots(params):
+    """THE paged acceptance gate: a mixed batch (one multi-chunk long
+    prompt, boundary lengths 16/17) decoded by the paged engine matches
+    sequential sample() AND the slot engine bit-for-bit; after drain the
+    pool shows zero leaks."""
+    prompts = [np.arange(40, dtype=np.int32) % CFG.vocab_size,
+               (np.arange(7, dtype=np.int32) * 3 + 1) % CFG.vocab_size,
+               (np.arange(17, dtype=np.int32) * 5 + 2) % CFG.vocab_size,
+               (np.arange(16, dtype=np.int32) * 7 + 3) % CFG.vocab_size]
+    mnts = [8, 6, 5, 4]
+    before = _counters()
+    paged = _adopt(ServingEngine(params, CFG, kv_mode="paged", slots=4,
+                                 max_len=64, name="paged-acc"))
+    res_paged = _run_mix(paged, prompts, mnts)
+    slot = ServingEngine(params, CFG, kv_mode="slots", slots=4,
+                         max_len=64, name="slot-acc")
+    res_slot = _run_mix(slot, prompts, mnts)
+    for i, (p, m) in enumerate(zip(prompts, mnts)):
+        got = np.asarray(res_paged[f"r{i}"]["tokens"], np.int32)
+        np.testing.assert_array_equal(got, _ref_tokens(params, p, m))
+        np.testing.assert_array_equal(
+            got, np.asarray(res_slot[f"r{i}"]["tokens"], np.int32))
+    d = lambda k: _counters().get(k, 0) - before.get(k, 0)  # noqa: E731
+    # 40 tokens at the default 32-token chunk = 2 chunks; the rest 1.
+    assert d("prefill_chunks") >= 5
+    assert d("serve_prefills") >= 4
+    # Drain clears the prefix cache: zero pages resident, zero refs.
+    paged.drain(wait_ms=0)
+    st = paged.stats()
+    assert st["pages_used"] == 0 and st["page_refs"] == 0
+    assert st["pages_reserved"] == 0 and st["pages_cached"] == 0
+
+
+def test_prefix_hits_skip_prefill_executable_for_shared_span(params):
+    """Shared-system-prompt requests must NOT re-run the prefill
+    executable for the shared span: serve_prefill_tokens grows by the
+    tails only, prefix_hits counts the two followers — and the outputs
+    stay bit-identical to sample()."""
+    engine = _adopt(ServingEngine(params, CFG, kv_mode="paged", slots=4,
+                                  max_len=64, name="paged-prefix"))
+    system = (np.arange(32, dtype=np.int32) * 11 + 5) % CFG.vocab_size
+    tails = [(np.arange(8, dtype=np.int32) * k + k) % CFG.vocab_size
+             for k in (1, 2, 3)]
+    prompts = [np.concatenate([system, t]).astype(np.int32)
+               for t in tails]
+    before = _counters()
+    results = {}
+    for i, p in enumerate(prompts):
+        # Sequential: each commit lands before the next attach.
+        engine.submit(f"r{i}", p, max_new_tokens=4, greedy=True)
+        engine.run_until_idle()
+        results.update({r["request_id"]: r
+                        for r in engine.poll([f"r{i}"])})
+    d = lambda k: _counters().get(k, 0) - before.get(k, 0)  # noqa: E731
+    assert d("prefix_hits") == 2
+    assert d("prefix_hit_tokens") == 64          # 2 followers x 32 tokens
+    total = sum(len(p) for p in prompts)
+    # Zero prefill-executable tokens for the shared span, tails only:
+    assert d("serve_prefill_tokens") == total - 64
+    for i, p in enumerate(prompts):
+        np.testing.assert_array_equal(
+            np.asarray(results[f"r{i}"]["tokens"], np.int32),
+            _ref_tokens(params, p, 4))
+    engine.drain(wait_ms=0)
+    st = engine.stats()
+    assert st["pages_used"] == 0 and st["page_refs"] == 0
+
+
+def test_chunked_prefill_interleaves_short_request(params):
+    """A 60-token prompt at prefill_chunk=16 takes 4 scheduler
+    iterations to prefill; a short request admitted alongside it gets
+    its first token while the long one is still chunking — chunked
+    prefill is what keeps short-request TTFT flat."""
+    engine = _adopt(ServingEngine(params, CFG, kv_mode="paged", slots=4,
+                                  max_len=64, prefill_chunk=16,
+                                  name="paged-chunks"))
+    long_p = (np.arange(60, dtype=np.int32) * 13 + 7) % CFG.vocab_size
+    short_p = np.asarray([9, 8, 7, 6], np.int32)
+    engine.submit("long", long_p, max_new_tokens=2, greedy=True)
+    engine.submit("short", short_p, max_new_tokens=2, greedy=True)
+    engine.step()       # admit both; one 16-token chunk each
+    st = engine.poll(["long", "short"])
+    by = {r["request_id"]: r for r in st}
+    assert by["short"]["n_tokens"] >= 1          # TTFT closed
+    assert by["long"]["status"] == "prefill"     # still chunking
+    assert by["long"]["n_tokens"] == 0
+    engine.run_until_idle()
+    res = {r["request_id"]: r for r in engine.poll(["long", "short"])}
+    np.testing.assert_array_equal(
+        np.asarray(res["long"]["tokens"], np.int32),
+        _ref_tokens(params, long_p, 2))
+    np.testing.assert_array_equal(
+        np.asarray(res["short"]["tokens"], np.int32),
+        _ref_tokens(params, short_p, 2))
+    assert res["short"]["ttft_ms"] < res["long"]["ttft_ms"]
+    d = _counters()
+    assert engine.stats()["prefill_chunk"] == 16
+
+
+def test_drain_hands_back_partially_prefilled_as_resubmittable(params):
+    """Drain mid-chunked-prefill: the request has emitted no tokens yet,
+    so it comes back as a clean resubmittable spec (same rid, full
+    prompt), its pages are returned, and a fresh engine run of the spec
+    is bit-identical."""
+    engine = _adopt(ServingEngine(params, CFG, kv_mode="paged", slots=4,
+                                  max_len=64, prefill_chunk=16,
+                                  name="paged-drain"))
+    prompt = (np.arange(60, dtype=np.int32) * 3 + 1) % CFG.vocab_size
+    engine.submit("part", prompt, max_new_tokens=3, greedy=True)
+    engine.step()                                # one chunk in
+    assert engine.poll(["part"])[0]["status"] == "prefill"
+    before = _counters()
+    handed = engine.drain(wait_ms=0)
+    assert len(handed) == 1
+    spec = handed[0]
+    assert spec["request_id"] == "part"
+    np.testing.assert_array_equal(
+        np.asarray(spec["prompt"], np.int32), prompt)
+    assert spec["max_new_tokens"] == 3 and spec["greedy"] is True
+    d = lambda k: _counters().get(k, 0) - before.get(k, 0)  # noqa: E731
+    assert d("drain_handoffs") == 1
+    st = engine.stats()
+    assert st["pages_used"] == 0 and st["page_refs"] == 0
+    assert st["pages_reserved"] == 0
+    # The spec replays cleanly on another replica.
+    engine2 = _adopt(ServingEngine(params, CFG, kv_mode="paged", slots=4,
+                                   max_len=64, prefill_chunk=16,
+                                   name="paged-drain2"))
+    engine2.submit(spec["request_id"], spec["prompt"],
+                   max_new_tokens=spec["max_new_tokens"],
+                   greedy=spec["greedy"])
+    engine2.run_until_idle()
+    np.testing.assert_array_equal(
+        np.asarray(engine2.poll(["part"])[0]["tokens"], np.int32),
+        _ref_tokens(params, prompt, 3))
+
+
+def test_supervisor_crash_mid_chunked_prefill_exactly_once(params):
+    """THE replay gate: the engine dies INSIDE a chunked prefill (2nd
+    chunk of a 3-chunk prompt); the supervisor rebuilds the pool, the
+    request replays from scratch (it had no tokens yet), and every
+    output is bit-identical to the fault-free reference — exactly
+    once."""
+    sup = ServingSupervisor(params, CFG, task_index=0, slots=4,
+                            max_len=64, prefill_chunk=16,
+                            name="paged-replay")
+    _adopt(sup.engine)
+    long_p = (np.arange(40, dtype=np.int32) * 17 + 3) % CFG.vocab_size
+    short_p = np.asarray([4, 5, 6], np.int32)
+    before = _counters()
+    sup.submit("long", long_p, max_new_tokens=4, greedy=True)
+    sup.submit("short", short_p, max_new_tokens=3, greedy=True)
+    faults.configure("serve_fault:op=prefill,step=2,ti=0")
+    try:
+        sup.run_until_idle()
+    finally:
+        faults.configure(None)
+    res = {r["request_id"]: r for r in sup.poll(["long", "short"])}
+    d = lambda k: _counters().get(k, 0) - before.get(k, 0)  # noqa: E731
+    assert d("fault_injected:serve_fault") == 1
+    assert d("engine_restarts") == 1
+    assert d("requests_replayed") >= 1
+    assert res["long"]["status"] == "done"
+    assert res["short"]["status"] == "done"
+    np.testing.assert_array_equal(
+        np.asarray(res["long"]["tokens"], np.int32),
+        _ref_tokens(params, long_p, 4))
+    np.testing.assert_array_equal(
+        np.asarray(res["short"]["tokens"], np.int32),
+        _ref_tokens(params, short_p, 3))
+    # Exactly once: each request completed a single time.
+    assert d("serve_requests_completed") == 2
+
+
+def test_paged_admits_2x_slot_residents_at_same_budget(params):
+    """Capacity acceptance: at the SAME emulated HBM budget (what a
+    2-slot x 32-token slot pool reserves), the paged engine keeps >= 2x
+    the residents, because short requests reserve pages_for(T+max_new-1)
+    instead of a whole max_len row."""
+    budget = pages_for(2 * 32, 16) * page_bytes(CFG, 16)
+    slot = ServingEngine(params, CFG, kv_mode="slots", slots=2,
+                         max_len=32, name="cap-slots")
+    paged = ServingEngine(params, CFG, kv_mode="paged", page_size=16,
+                          hbm_budget_bytes=budget, max_len=32,
+                          name="cap-paged")
+    assert paged.model.n_pages == 4
+    prompts = [(np.arange(5, dtype=np.int32) + k) % CFG.vocab_size
+               for k in range(4)]
+    for eng in (slot, paged):
+        for i, p in enumerate(prompts):
+            assert eng.submit(f"c{i}", p, max_new_tokens=5,
+                              greedy=True)["status"] == "queued"
+        eng.step()                           # one admission wave
+    assert slot.stats()["slots_used"] == 2
+    resident = paged.stats()["resident"]
+    assert resident >= 2 * slot.stats()["slots_used"]
+    for eng in (slot, paged):
+        eng.run_until_idle()
+        res = {r["request_id"]: r
+               for r in eng.poll([f"c{i}" for i in range(4)])}
+        for i, p in enumerate(prompts):
+            np.testing.assert_array_equal(
+                np.asarray(res[f"c{i}"]["tokens"], np.int32),
+                _ref_tokens(params, p, 5))
+
+
+# ---------------------------------------------------------------------------
+# Static gate + constructor validation
+# ---------------------------------------------------------------------------
+
+def test_verify_servable_paged_arm():
+    cfg = gpt2.GPT2Config(vocab_size=256, n_ctx=64, n_embd=32,
+                          n_layer=2, n_head=2)
+    verify_servable(cfg, slots=0, max_len=32, buckets=[8, 16, 32],
+                    kv_mode="paged", page_size=16, n_pages=4)
+    with pytest.raises(PlanVerificationError) as ei:
+        verify_servable(cfg, slots=0, max_len=32, buckets=[8, 16, 32],
+                        kv_mode="paged", page_size=16, n_pages=1)
+    assert ei.value.kind == "servable"       # pool < one max_len request
+    with pytest.raises(PlanVerificationError):
+        verify_servable(cfg, slots=0, max_len=32, buckets=[8, 16, 32],
+                        kv_mode="paged", page_size=None, n_pages=4)
+    with pytest.raises(PlanVerificationError):
+        verify_servable(cfg, slots=2, max_len=32, buckets=[8, 16, 32],
+                        kv_mode="segmented")
+    with pytest.raises(PlanVerificationError) as ei:
+        verify_servable(cfg, slots=0, max_len=32, buckets=[8, 16, 32],
+                        kv_mode="paged", page_size=16, n_pages=4,
+                        hbm_limit_bytes=1e4)
+    assert ei.value.kind == "hbm_overflow"
+
+
+def test_paged_constructor_validation(params):
+    with pytest.raises(ValueError, match="kv_mode"):
+        ServingEngine(params, CFG, kv_mode="bogus")
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        PagedServableModel(params, CFG, page_size=16, prefill_chunk=10)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        PagedServableModel(params, CFG, page_size=16, prefill_chunk=0)

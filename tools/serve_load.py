@@ -29,7 +29,12 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
              greedy: bool = True, deadline_ms: Optional[float] = None,
              fault_spec: Optional[str] = None,
              trace: Optional[str] = None,
-             timeout_s: float = 300.0) -> Dict[str, Any]:
+             timeout_s: float = 300.0,
+             kv_mode: str = "paged", page_size: int = 16,
+             hbm_budget_bytes: Optional[float] = None,
+             prefill_chunk: Optional[int] = None,
+             shared_prefix: int = 0,
+             long_prompt: int = 0) -> Dict[str, Any]:
     import jax
 
     from tepdist_tpu import telemetry
@@ -50,9 +55,22 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
     sc = ServeClient(clients=clients)
     rng = np.random.RandomState(seed)
     before = telemetry.metrics().snapshot()
+    # --shared-prefix: every request opens with the SAME system prompt,
+    # so the paged engine's prefix cache should absorb the shared span
+    # after the first prefill per worker (prefix_hit_rate below).
+    if shared_prefix + 2 > max_len:
+        raise ValueError(
+            f"--shared-prefix {shared_prefix} leaves no room for a "
+            f"prompt tail + one generated token within --max-len "
+            f"{max_len} (need shared_prefix + 2 <= max_len)")
+    system = (rng.randint(0, cfg.vocab_size,
+                          size=shared_prefix).astype(np.int32)
+              if shared_prefix else np.zeros(0, np.int32))
     try:
         sc.load(params, cfg, slots=slots, max_len=max_len,
-                name="loadgen")
+                name="loadgen", kv_mode=kv_mode, page_size=page_size,
+                hbm_budget_bytes=hbm_budget_bytes,
+                prefill_chunk=prefill_chunk)
         reqs: List[Dict[str, Any]] = []
         if fault_spec:
             faults.configure(fault_spec)
@@ -61,13 +79,22 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
             for i in range(requests):
                 t = int(rng.randint(prompt_len[0], prompt_len[1] + 1))
                 m = int(rng.randint(max_new[0], max_new[1] + 1))
-                m = min(m, max_len - t)
-                prompt = rng.randint(0, cfg.vocab_size,
-                                     size=t).astype(np.int32)
+                if long_prompt and i == 0:
+                    # One long prompt in flight: with chunked prefill the
+                    # short requests' TTFT p99 must not hide behind it.
+                    t = max(t, long_prompt - len(system))
+                # Clamp to >= 1 so a large --shared-prefix or a
+                # --long-prompt near max_len shrinks the tail/output
+                # instead of driving t or m negative.
+                t = max(1, min(t, max_len - len(system) - m))
+                m = max(1, min(m, max_len - len(system) - t))
+                tail = rng.randint(0, cfg.vocab_size,
+                                   size=t).astype(np.int32)
+                prompt = np.concatenate([system, tail])
                 out = sc.submit(prompt, max_new_tokens=m, greedy=greedy,
                                 seed=i, deadline_ms=deadline_ms)
                 reqs.append({"rid": out["request_id"],
-                             "prompt_len": t, "max_new": m,
+                             "prompt_len": len(prompt), "max_new": m,
                              "admission": out["status"]})
             results = sc.wait([r["rid"] for r in reqs],
                               timeout_s=timeout_s)
@@ -99,6 +126,7 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
                 - before["counters"].get(name, 0))
 
     tok_hist = after.get("histograms", {}).get("serve_token_ms", {})
+    ttft_hist = after.get("histograms", {}).get("serve_ttft_ms", {})
 
     def _slo(vals) -> Dict[str, Optional[float]]:
         # SLO percentiles, not means — p95/p99 are what a latency SLO is
@@ -112,13 +140,23 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
                 "p99": round(float(np.percentile(vals, 99)), 3),
                 "max": round(float(np.max(vals)), 3)}
 
+    prefix_hits = delta("prefix_hits")
     summary = {
         "requests": requests,
         "statuses": statuses,
+        "kv_mode": kv_mode,
         "wall_s": round(wall_s, 3),
         "tokens": n_tokens,
         "tokens_per_s": round(n_tokens / wall_s, 2) if wall_s else None,
         "ttft_ms": _slo(ttfts),
+        # Reservoir-percentile view of the same SLO (the registry's
+        # serve_ttft_ms histogram — survives across runs/restarts where
+        # the per-request list above is this call's sample only).
+        "ttft_hist_ms": {
+            k: (round(ttft_hist[k], 3)
+                if ttft_hist.get(k) is not None else None)
+            for k in ("mean", "p50", "p95", "p99", "max")}
+        if ttft_hist else None,
         "token_ms": {
             k: (round(tok_hist[k], 3)
                 if tok_hist.get(k) is not None else None)
@@ -129,6 +167,16 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
                            if decode_ms else None),
         "decode_steps": delta("serve_decode_steps"),
         "prefills": delta("serve_prefills"),
+        "prefill_chunks": delta("prefill_chunks"),
+        "prefill_tokens": delta("serve_prefill_tokens"),
+        "prefix_hits": prefix_hits,
+        "prefix_hit_tokens": delta("prefix_hit_tokens"),
+        "prefix_hit_rate": (round(prefix_hits / requests, 3)
+                            if requests else None),
+        "prefix_evictions": delta("prefix_evictions"),
+        "pages_used_after_drain": (
+            int(after.get("gauges", {}).get("pages_used", 0))
+            if kv_mode == "paged" else None),
         "compiles": delta("serve_compiles"),
         "rpc_retries": delta("rpc_retries"),
         "dedup_hits": delta("dedup_hits"),
@@ -152,6 +200,21 @@ def main(argv=None) -> Dict[str, Any]:
     ap.add_argument("--prompt-len", type=int, nargs=2, default=(3, 16))
     ap.add_argument("--max-new", type=int, nargs=2, default=(2, 10))
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kv-mode", choices=("paged", "slots"),
+                    default="paged")
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--hbm-budget", type=float, default=None,
+                    help="emulated HBM bytes for the paged pool "
+                         "(sizes n_pages; default: slots-compat)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="chunked-prefill tokens per scheduler "
+                         "iteration (default 2x page size)")
+    ap.add_argument("--shared-prefix", type=int, default=0,
+                    help="prepend a SHARED system prompt of N tokens to "
+                         "every request (prefix-cache workload)")
+    ap.add_argument("--long-prompt", type=int, default=0,
+                    help="make request 0 a long prompt of ~N tokens "
+                         "(chunked-prefill TTFT interference probe)")
     ap.add_argument("--deadline-ms", type=float, default=None)
     ap.add_argument("--fault-spec", default=None,
                     help="runtime/faults.py grammar, e.g. "
@@ -160,26 +223,45 @@ def main(argv=None) -> Dict[str, Any]:
                     help="dump the merged trace JSON here")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
+    if args.shared_prefix + 2 > args.max_len:
+        ap.error(f"--shared-prefix {args.shared_prefix} leaves no room "
+                 f"for a prompt tail + one generated token within "
+                 f"--max-len {args.max_len}")
     summary = run_load(
         config=args.config, workers=args.workers, slots=args.slots,
         requests=args.requests, max_len=args.max_len,
         prompt_len=tuple(args.prompt_len), max_new=tuple(args.max_new),
         seed=args.seed, deadline_ms=args.deadline_ms,
-        fault_spec=args.fault_spec, trace=args.trace)
+        fault_spec=args.fault_spec, trace=args.trace,
+        kv_mode=args.kv_mode, page_size=args.page_size,
+        hbm_budget_bytes=args.hbm_budget,
+        prefill_chunk=args.prefill_chunk,
+        shared_prefix=args.shared_prefix,
+        long_prompt=args.long_prompt)
     if args.json:
         print(json.dumps(summary, indent=1))
     else:
         print(f"{summary['requests']} requests -> {summary['statuses']} "
               f"in {summary['wall_s']}s "
-              f"({summary['tokens_per_s']} tok/s)")
+              f"({summary['tokens_per_s']} tok/s, "
+              f"kv={summary['kv_mode']})")
         print(f"  ttft ms: {summary['ttft_ms']}")
+        if summary["ttft_hist_ms"]:
+            print(f"  ttft ms (reservoir): {summary['ttft_hist_ms']}")
         print(f"  token ms: {summary['token_ms']}  "
               f"decode_ms mean: {summary['decode_ms_mean']}")
         print(f"  prefills={summary['prefills']} "
+              f"chunks={summary['prefill_chunks']} "
               f"decode_steps={summary['decode_steps']} "
               f"compiles={summary['compiles']} "
               f"retries={summary['rpc_retries']} "
               f"dedup={summary['dedup_hits']}")
+        print(f"  prefix_hits={summary['prefix_hits']} "
+              f"(rate {summary['prefix_hit_rate']}, "
+              f"{summary['prefix_hit_tokens']} tokens) "
+              f"evictions={summary['prefix_evictions']} "
+              f"pages_used_after_drain="
+              f"{summary['pages_used_after_drain']}")
         print(f"  shed={summary['shed']} "
               f"engine_restarts={summary['engine_restarts']} "
               f"replayed={summary['requests_replayed']} "

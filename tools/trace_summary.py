@@ -205,6 +205,22 @@ def main() -> None:
                 # exactly the tail the SLO is about.
                 print(f"  {k:<28} {_pctl(h)} mean={h['mean']:.3f} "
                       f"max={h['max']:.3f} n={h['count']}")
+    # Paged-KV plane: page-pool occupancy and prefix-cache effectiveness
+    # (absent entirely under the slot fallback — don't print zeros).
+    PAGED_COUNTERS = ("prefill_chunks", "prefix_hits",
+                      "prefix_hit_tokens", "prefix_evictions",
+                      "pages_cow")
+    paged = {k: counters[k] for k in PAGED_COUNTERS if k in counters}
+    paged_gauges = {k: v for k, v in
+                    (((s.get("metrics") or {}).get("gauges")
+                      or {}).items())
+                    if k in ("pages_used", "pages_free", "pages_cached")}
+    if paged or paged_gauges:
+        print("paged kv:")
+        for k, v in sorted(paged.items()):
+            print(f"  {k:<28} {v}")
+        for k, v in sorted(paged_gauges.items()):
+            print(f"  {k:<28} {v} (gauge)")
     rpc_hists = {k: h for k, h in
                  ((s.get("metrics") or {}).get("histograms")
                   or {}).items() if k.startswith("rpc_ms:")}
