@@ -646,6 +646,80 @@ def bench_plan_verify(rounds: int = 20) -> dict:
     }
 
 
+def bench_ledger_overhead(steps: int = 6, warmup: int = 2) -> dict:
+    """RPC-ledger + flight-recorder cost on the two-worker in-proc fleet
+    fixture: min-of-steps wall with both instruments OFF vs ON (tracing
+    off in both arms, isolating the PR 9 hooks). The acceptance bound is
+    <= 2% of step time enabled; disabled is the ``active() is None``
+    branch-only fast path, so ``disabled_noop`` asserts it stays a no-op
+    rather than timing noise."""
+    import optax
+
+    from tepdist_tpu import telemetry
+    from tepdist_tpu.parallel.pipeline import plan_pipeline
+    from tepdist_tpu.rpc.inproc import (close_inproc_cluster,
+                                        make_inproc_cluster)
+    from tepdist_tpu.runtime.distributed_executor import (
+        DistributedPipelineSession,
+    )
+    from tepdist_tpu.telemetry import flight
+    from tepdist_tpu.telemetry import ledger
+
+    def loss_fn(params, x, y):
+        h = x
+        for i in range(4):
+            h = jnp.tanh(h @ params[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    keys = jax.random.split(k, 6)
+    params = {f"w{i}": jax.random.normal(keys[i], (16, 16)) * 0.3
+              for i in range(4)}
+    x = jax.random.normal(keys[4], (8, 16))
+    y = jax.random.normal(keys[5], (8, 16))
+
+    telemetry.trace.configure(enabled=False)
+
+    def fleet_min_ms(led_on: bool) -> float:
+        ledger.configure(enabled=led_on)
+        flight.configure(enabled=led_on)
+        prog = plan_pipeline(loss_fn, 2, 2, params, x, y)
+        cluster, _serv = make_inproc_cluster(2, jax.devices()[:1])
+        sess = DistributedPipelineSession(prog, cluster,
+                                          optimizer=optax.sgd(1e-2))
+        try:
+            sess.load_variables(params)
+            for _ in range(warmup):
+                sess.step(x, y)
+            best = float("inf")
+            for _ in range(steps):
+                t0 = time.perf_counter()
+                sess.step(x, y)
+                best = min(best, time.perf_counter() - t0)
+        finally:
+            sess.close()
+            close_inproc_cluster(cluster)
+        return best * 1e3
+
+    try:
+        off_ms = fleet_min_ms(False)
+        noop = ledger.active() is None
+        on_ms = fleet_min_ms(True)
+    finally:
+        ledger.configure(enabled=False)
+        flight.configure(enabled=True)   # flight defaults ON
+    pct = max((on_ms - off_ms) / off_ms * 100.0, 0.0) if off_ms else 0.0
+    return {
+        "metric": "ledger_overhead_pct",
+        "value": round(pct, 2),
+        "unit": "% of fleet step (min-of-steps, ledger+flight on vs off)",
+        "fleet_step_off_ms": round(off_ms, 3),
+        "fleet_step_on_ms": round(on_ms, 3),
+        "disabled_noop": noop,
+        "gate_below_2pct": bool(pct <= 2.0),
+    }
+
+
 def bench_serving(n_requests: int = 16, rounds: int = 3) -> dict:
     """Continuous-batching serving throughput (tepdist_tpu/serving/):
     one engine, mixed prompt/output lengths, decode tokens/s with the
@@ -867,6 +941,11 @@ def main() -> None:
         except Exception:
             extra.append({"metric": "plan_verify_ms", "error":
                           traceback.format_exc(limit=3).splitlines()[-1]})
+        try:
+            extra.append(bench_ledger_overhead())
+        except Exception:
+            extra.append({"metric": "ledger_overhead_pct", "error":
+                          traceback.format_exc(limit=3).splitlines()[-1]})
         # Carry forward the last TPU round's secondary lines STALE-FLAGGED
         # (mirroring the headline policy) instead of silently dropping
         # them: the fresh runtime line replaces only its own metric.
@@ -930,6 +1009,7 @@ def main() -> None:
             pass
     selected = {
         "trace": bench_trace_overhead,   # ~ms; telemetry no-op guarantee
+        "ledger": bench_ledger_overhead,  # RPC ledger+flight hook cost
         "serving": bench_serving,        # continuous-batching decode tok/s
         "paged": bench_paged_capacity,   # paged-vs-slots admission capacity
         "117m": lambda: bench_gpt2_117m(True),

@@ -103,6 +103,19 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
     ("LOWERING_POSTCHECK", bool, True, "winner-only involuntary-remat "
      "lowering check after exploration (parallel/lowering_check.py); "
      "records the involuntary_remat counter + a warning"),
+    ("TEPDIST_LEDGER", bool, False, "per-verb RPC wire/serde ledger "
+     "(telemetry/ledger.py): call counts, header vs blob bytes, "
+     "encode/decode wall time, handler time, retry backoff — reduced to "
+     "the serde/orchestration/idle/compute gap table by "
+     "tools/ledger_report.py; off by default (hot-path hooks cost one "
+     "branch when off)"),
+    ("TEPDIST_FLIGHT", bool, True, "serving flight recorder "
+     "(telemetry/flight.py): bounded ring of per-request waterfall "
+     "events (submit/admit/prefill/decode/restart/deliver) rendered by "
+     "tools/request_trace.py; on by default — one dict append per event"),
+    ("TEPDIST_FLIGHT_CAPACITY", int, 8192, "flight-recorder ring "
+     "capacity per process (oldest events dropped; overflow exported as "
+     "dropped)"),
     # --- static analysis --------------------------------------------------
     ("TEPDIST_VERIFY_PLAN", bool,
      "pytest" in sys.modules or "PYTEST_CURRENT_TEST" in os.environ,

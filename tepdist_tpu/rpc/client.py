@@ -28,6 +28,7 @@ import numpy as np
 
 from tepdist_tpu.rpc import protocol, retry
 from tepdist_tpu.runtime import faults
+from tepdist_tpu.telemetry import ledger as wire_ledger
 from tepdist_tpu.telemetry import metrics, span
 
 # Mutating verbs that carry an idempotency token: a retried request whose
@@ -74,8 +75,11 @@ class GRPCStub:
              max_attempts: Optional[int] = None) -> bytes:
         timeout = retry.deadline_for(method, timeout)
         t0 = time.perf_counter()
-        with span(f"rpc:{method}", cat="rpc", addr=self.address,
-                  req_bytes=len(payload)) as sp:
+        # The ledger scope sits here (the stub, not TepdistClient) so
+        # direct stub users — worker_plan's peer pushes — are accounted.
+        with wire_ledger.client_scope(method), \
+                span(f"rpc:{method}", cat="rpc", addr=self.address,
+                     req_bytes=len(payload)) as sp:
             resp = retry.call_with_retry(self._call_once, method, payload,
                                          timeout, max_attempts=max_attempts)
             sp.set(resp_bytes=len(resp))
@@ -137,8 +141,13 @@ class TepdistClient:
         if method in IDEMPOTENT_TOKEN_VERBS and "idem" not in header:
             header = dict(header)
             header["idem"] = f"{self._uid}:{method}:{next(self._idem_seq)}"
-        return self.stub.call(method, protocol.pack(header, list(blobs)),
-                              timeout=timeout, max_attempts=max_attempts)
+        # Ledger step attribution: the header's step= tag covers the pack
+        # (and, in-proc, the whole server handler on this same thread).
+        with wire_ledger.step_hint(header.get("step")):
+            return self.stub.call(method,
+                                  protocol.pack(header, list(blobs)),
+                                  timeout=timeout,
+                                  max_attempts=max_attempts)
 
     # -- lifecycle ----------------------------------------------------
     def ping(self) -> Dict[str, Any]:

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 
 from tepdist_tpu.rpc import protocol, retry
 from tepdist_tpu.runtime import faults
+from tepdist_tpu.telemetry import ledger as wire_ledger
 from tepdist_tpu.telemetry import metrics, span
 
 _SERVICERS: Dict[str, object] = {}
@@ -66,8 +67,11 @@ class InProcStub:
              max_attempts: Optional[int] = None) -> bytes:
         timeout = retry.deadline_for(method, timeout)
         t0 = time.perf_counter()
-        with span(f"rpc:{method}", cat="rpc", addr=self.address,
-                  req_bytes=len(payload)) as sp:
+        # Ledger client scope here (not TepdistClient) so direct stub
+        # users — worker_plan's peer pushes — are accounted too.
+        with wire_ledger.client_scope(method), \
+                span(f"rpc:{method}", cat="rpc", addr=self.address,
+                     req_bytes=len(payload)) as sp:
             resp = retry.call_with_retry(self._call_once, method, payload,
                                          timeout, max_attempts=max_attempts)
             sp.set(resp_bytes=len(resp))
@@ -103,7 +107,12 @@ class InProcStub:
                     f"{method} request to worker {ti} dropped",
                     kind="rpc_drop")
         try:
-            resp = getattr(servicer, method)(payload, None)
+            # The handler runs on the CALLER's thread: the server scope
+            # nests inside the client scope and inherits its step tag, so
+            # in-proc handler time lands in the right step with no header
+            # plumbing.
+            with wire_ledger.server_scope(method):
+                resp = getattr(servicer, method)(payload, None)
         except faults.InjectedFault:
             raise                     # server-side injection: retryable
         except (ConnectionError, TimeoutError):

@@ -57,10 +57,12 @@ from __future__ import annotations
 
 import json
 import struct
+import time
 from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
+from tepdist_tpu.telemetry import ledger as wire_ledger
 from tepdist_tpu.telemetry.trace import span
 
 SERVICE_NAME = "tepdist.TepdistService"
@@ -99,39 +101,66 @@ _MAGIC = b"TPD1"
 
 def pack(header: Dict[str, Any], blobs: List[bytes] = ()) -> bytes:
     """Envelope: MAGIC | u32 header_len | header_json | u32 n_blobs |
-    (u64 len | bytes)*"""
-    h = json.dumps(header, separators=(",", ":")).encode()
-    parts = [_MAGIC, struct.pack("<I", len(h)), h,
-             struct.pack("<I", len(blobs))]
-    for b in blobs:
-        parts.append(struct.pack("<Q", len(b)))
-        parts.append(bytes(b))
-    return b"".join(parts)
+    (u64 len | bytes)*
+
+    Ledger accounting (telemetry/ledger.py, when enabled): header bytes
+    are the full envelope minus the raw blob payloads — framing + JSON —
+    so ledger header + blob bytes equal ``len(frame)`` exactly."""
+    led = wire_ledger.active()
+    # Ledger timestamps bracket ONLY the inner work, inside the span, and
+    # the locked ledger record runs after the span closes: neither
+    # instrument counts the other's recording overhead, so the gap
+    # table's serde bucket and the fidelity attribution's host_serde lane
+    # reconcile (at toy frame sizes a few us/op of mutual overhead would
+    # otherwise dominate the comparison).
+    with span("serde:pack", cat="serde") as sp:
+        t0 = time.time_ns() // 1000 if led is not None else 0
+        h = json.dumps(header, separators=(",", ":")).encode()
+        parts = [_MAGIC, struct.pack("<I", len(h)), h,
+                 struct.pack("<I", len(blobs))]
+        for b in blobs:
+            parts.append(struct.pack("<Q", len(b)))
+            parts.append(bytes(b))
+        frame = b"".join(parts)
+        sp.set(bytes=len(frame))
+        t1 = time.time_ns() // 1000 if led is not None else 0
+    if led is not None:
+        blob_total = sum(len(b) for b in blobs)
+        led.record_pack(len(frame) - blob_total, blob_total, t0, t1)
+    return frame
 
 
 def unpack(data: bytes) -> Tuple[Dict[str, Any], List[bytes]]:
+    led = wire_ledger.active()
     total = len(data)
     if total < 12 or data[:4] != _MAGIC:
         raise ValueError("bad envelope magic")
-    off = 4
-    (hlen,) = struct.unpack_from("<I", data, off)
-    off += 4
-    if off + hlen + 4 > total:
-        raise ValueError("truncated envelope (header)")
-    header = json.loads(data[off:off + hlen].decode())
-    off += hlen
-    (n,) = struct.unpack_from("<I", data, off)
-    off += 4
-    blobs = []
-    for i in range(n):
-        if off + 8 > total:
-            raise ValueError(f"truncated envelope (blob {i} length)")
-        (blen,) = struct.unpack_from("<Q", data, off)
-        off += 8
-        if off + blen > total:
-            raise ValueError(f"truncated envelope (blob {i} payload)")
-        blobs.append(data[off:off + blen])
-        off += blen
+    with span("serde:unpack", cat="serde") as sp:
+        t0 = time.time_ns() // 1000 if led is not None else 0
+        off = 4
+        (hlen,) = struct.unpack_from("<I", data, off)
+        off += 4
+        if off + hlen + 4 > total:
+            raise ValueError("truncated envelope (header)")
+        header = json.loads(data[off:off + hlen].decode())
+        off += hlen
+        (n,) = struct.unpack_from("<I", data, off)
+        off += 4
+        blobs = []
+        for i in range(n):
+            if off + 8 > total:
+                raise ValueError(f"truncated envelope (blob {i} length)")
+            (blen,) = struct.unpack_from("<Q", data, off)
+            off += 8
+            if off + blen > total:
+                raise ValueError(f"truncated envelope (blob {i} payload)")
+            blobs.append(data[off:off + blen])
+            off += blen
+        sp.set(bytes=total)
+        t1 = time.time_ns() // 1000 if led is not None else 0
+    if led is not None:
+        blob_total = sum(len(b) for b in blobs)
+        led.record_unpack(total - blob_total, blob_total, t0, t1)
     return header, blobs
 
 
@@ -142,15 +171,22 @@ def unpack(data: bytes) -> Tuple[Dict[str, Any], List[bytes]]:
 # verdict, measured permanently. Disabled tracing costs one branch.
 
 def encode_literal(x) -> Tuple[Dict[str, Any], bytes]:
+    led = wire_ledger.active()
     with span("serde:encode", cat="serde") as sp:
+        t0 = time.time_ns() // 1000 if led is not None else 0
         arr = np.asarray(x)
         blob = np.ascontiguousarray(arr).tobytes()
         sp.set(bytes=len(blob))
-        return ({"dtype": arr.dtype.name, "shape": list(arr.shape)}, blob)
+        t1 = time.time_ns() // 1000 if led is not None else 0
+    if led is not None:
+        led.record_encode(t0, t1)
+    return ({"dtype": arr.dtype.name, "shape": list(arr.shape)}, blob)
 
 
 def decode_literal(meta: Dict[str, Any], blob: bytes) -> np.ndarray:
+    led = wire_ledger.active()
     with span("serde:decode", cat="serde") as sp:
+        t0 = time.time_ns() // 1000 if led is not None else 0
         name = meta["dtype"]
         try:
             dt = np.dtype(name)
@@ -158,7 +194,11 @@ def decode_literal(meta: Dict[str, Any], blob: bytes) -> np.ndarray:
             import ml_dtypes
             dt = np.dtype(getattr(ml_dtypes, name))
         sp.set(bytes=len(blob))
-        return np.frombuffer(blob, dtype=dt).reshape(meta["shape"])
+        out = np.frombuffer(blob, dtype=dt).reshape(meta["shape"])
+        t1 = time.time_ns() // 1000 if led is not None else 0
+    if led is not None:
+        led.record_decode(t0, t1)
+    return out
 
 
 def method_path(name: str) -> str:

@@ -35,6 +35,7 @@ import random
 import time
 from typing import Callable, List, Optional
 
+from tepdist_tpu.telemetry import ledger as wire_ledger
 from tepdist_tpu.telemetry import metrics
 
 # Per-verb deadlines (seconds) replacing the old blanket 300 s default:
@@ -180,5 +181,10 @@ def call_with_retry(send: Callable[[str, bytes, float], bytes],
             m = metrics()
             m.counter("rpc_retries").inc()
             m.counter(f"rpc_retries:{method}").inc()
+            led = wire_ledger.active()
+            if led is not None:
+                # Backoff sleep is the client-side queue wait the ledger
+                # charges against the verb.
+                led.record_retry(method, delays[attempt])
             time.sleep(delays[attempt])
     raise AssertionError("unreachable")  # pragma: no cover

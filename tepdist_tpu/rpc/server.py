@@ -35,6 +35,8 @@ from tepdist_tpu.core.service_env import ServiceEnv
 from tepdist_tpu.rpc import protocol
 from tepdist_tpu.rpc.jaxpr_serde import deserialize_closed_jaxpr
 from tepdist_tpu.runtime import faults
+from tepdist_tpu.telemetry import flight
+from tepdist_tpu.telemetry import ledger as wire_ledger
 from tepdist_tpu.telemetry import metrics, span
 
 log = logging.getLogger("tepdist.server")
@@ -1093,7 +1095,11 @@ class TepdistServicer:
         if self.worker_plan is None:
             return protocol.pack({"ok": True, "losses": []})
         step = int(header.get("step", 0))
-        with span("ExecuteRemotePlan", cat="rpc", step=step):
+        # step_hint: peer pushes made from run_step on THIS thread carry
+        # the step tag into the ledger (inproc keeps the client's TLS, but
+        # a gRPC worker thread starts cold).
+        with span("ExecuteRemotePlan", cat="rpc", step=step), \
+                wire_ledger.step_hint(step):
             result = self.worker_plan.run_step(step)
         return protocol.pack({"ok": True, **result})
 
@@ -1253,7 +1259,8 @@ class TepdistServicer:
         header, _ = protocol.unpack(request)
         t = telemetry.tracer()
         dropped = t.dropped
-        spans = t.snapshot(clear=bool(header.get("clear")))
+        clear = bool(header.get("clear"))
+        spans = t.snapshot(clear=clear)
         return protocol.pack({
             "ok": True,
             "task_index": self.task_index,
@@ -1262,6 +1269,8 @@ class TepdistServicer:
             "spans": spans,
             "spans_dropped": dropped,
             "metrics": telemetry.metrics().snapshot(),
+            "ledger": wire_ledger.ledger().snapshot(clear=clear),
+            "flight": flight.recorder().snapshot(clear=clear),
         })
 
     # -- serving verbs (tepdist_tpu/serving/) ---------------------------
@@ -1423,10 +1432,13 @@ def create_server(port: int, devices=None, task_index: int = 0,
     for m in protocol.METHODS:
         fn = getattr(servicer, m)
 
-        def make(fn=fn):
+        def make(fn=fn, m=m):
             def handler(request, context):
                 try:
-                    return fn(request, context)
+                    # Ledger handler timing: the gRPC analogue of the
+                    # in-proc server_scope (rpc/inproc.py _call_once).
+                    with wire_ledger.server_scope(m):
+                        return fn(request, context)
                 except Exception as e:  # surface server errors to client
                     log.exception("RPC failed")
                     import grpc as _g

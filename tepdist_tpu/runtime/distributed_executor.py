@@ -27,7 +27,9 @@ from tepdist_tpu.runtime.coordinator import serialize_task
 from tepdist_tpu.runtime.execution_plan import build_pipeline_task_dag
 from tepdist_tpu.runtime.task_graph import TaskType
 from tepdist_tpu.runtime.task_scheduler import TaskScheduler
+from tepdist_tpu.telemetry import ledger as wire_ledger
 from tepdist_tpu.telemetry import metrics
+from tepdist_tpu.telemetry import span
 
 log = logging.getLogger(__name__)
 
@@ -301,6 +303,17 @@ class DistributedPipelineSession:
 
     # ------------------------------------------------------------------
     def step(self, *batch) -> float:
+        # The ledger step window brackets the WHOLE master-side step —
+        # including recovery re-execution, which widens the same window —
+        # and tags this thread's pack/rpc records with step=. The
+        # master_step span gives the fidelity attribution the same frame:
+        # without it, host serde on the push path (before any worker's
+        # run_step opens) would be clamped out of the step window.
+        with wire_ledger.step_scope(self._step), \
+                span("master_step", cat="step", step=self._step):
+            return self._step_body(*batch)
+
+    def _step_body(self, *batch) -> float:
         prog = self.prog
         M = prog.num_micro_batches
         bdim = prog.batch_dim
@@ -311,33 +324,39 @@ class DistributedPipelineSession:
         # through the same failure path as execution errors so elastic
         # re-dispatch can react before anything runs.
         push_errors: Dict[int, Exception] = {}
-        for s, gis in self._batch_stages.items():
-            ti = self.stage_worker[s]
-            if ti in push_errors:
-                continue
-            for gi in gis:
-                leaf = np.asarray(leaves[gi - self._n_params])
-                msize = leaf.shape[bdim] // M
-                try:
-                    # All M micro slices in ONE RPC (per-micro round
-                    # trips dominated the fleet step time).
-                    entries, blobs = [], []
-                    for m in range(M):
-                        sl = np.take(leaf,
-                                     range(m * msize, (m + 1) * msize),
-                                     axis=bdim)
-                        meta, blob = protocol.encode_literal(sl)
-                        entries.append(
-                            {"raw_key": f"batch:{step}:{m}:{gi}",
-                             "literal": meta})
-                        blobs.append(blob)
-                    self.clients[ti].call(
-                        "TransferHostRawData",
-                        {"raw_multi": entries,
-                         "plan_gen": self._plan_gen}, blobs)
-                except Exception as e:  # noqa: BLE001
-                    push_errors[ti] = e
-                    break
+        # The ledger "master:*" scopes are dispatch envelopes, not wire
+        # verbs: they attribute the master's own Python (slicing, header
+        # assembly, thread fan-out, completion wait) to the
+        # rpc_orchestration bucket of the gap table instead of leaving it
+        # unattributed. Nested real-verb scopes still win for their span.
+        with wire_ledger.client_scope("master:push"):
+            for s, gis in self._batch_stages.items():
+                ti = self.stage_worker[s]
+                if ti in push_errors:
+                    continue
+                for gi in gis:
+                    leaf = np.asarray(leaves[gi - self._n_params])
+                    msize = leaf.shape[bdim] // M
+                    try:
+                        # All M micro slices in ONE RPC (per-micro round
+                        # trips dominated the fleet step time).
+                        entries, blobs = [], []
+                        for m in range(M):
+                            sl = np.take(leaf,
+                                         range(m * msize, (m + 1) * msize),
+                                         axis=bdim)
+                            meta, blob = protocol.encode_literal(sl)
+                            entries.append(
+                                {"raw_key": f"batch:{step}:{m}:{gi}",
+                                 "literal": meta})
+                            blobs.append(blob)
+                        self.clients[ti].call(
+                            "TransferHostRawData",
+                            {"raw_multi": entries, "step": step,
+                             "plan_gen": self._plan_gen}, blobs)
+                    except Exception as e:  # noqa: BLE001
+                        push_errors[ti] = e
+                        break
         if push_errors:
             # Same transient/permanent ladder as the execute path below: a
             # push can fail transiently without the worker being gone, and
@@ -356,9 +375,10 @@ class DistributedPipelineSession:
 
         threads = [threading.Thread(target=run, args=(ti, c), daemon=True)
                    for ti, c in self.clients.items()]
-        for t in threads:
-            t.start()
-        self._join_with_heartbeat(threads, errors)
+        with wire_ledger.client_scope("master:execute"):
+            for t in threads:
+                t.start()
+            self._join_with_heartbeat(threads, errors)
         # Snapshot: abandoned daemon threads (still blocked past the grace
         # join) may write into `errors` while we iterate it below.
         errors = dict(errors)

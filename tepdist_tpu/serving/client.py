@@ -44,7 +44,7 @@ from tepdist_tpu.models.gpt2 import GPT2Config
 from tepdist_tpu.rpc.client import TepdistClient
 from tepdist_tpu.serving.engine import TERMINAL
 from tepdist_tpu.serving.kv_cache import config_to_spec
-from tepdist_tpu.telemetry import metrics
+from tepdist_tpu.telemetry import flight, metrics
 
 
 class ServeOverloadError(RuntimeError):
@@ -159,6 +159,9 @@ class ServeClient:
         if not self._placements:
             raise RuntimeError("load() a servable first")
         rid = request_id or f"{self._uid}-{next(self._rid_seq)}"
+        flight.record(rid, "submit",
+                      prompt_len=int(np.asarray(prompt).size),
+                      max_new_tokens=int(max_new_tokens))
         n = len(self._placements)
         last: Any = None
         for _ in range(n):
@@ -179,11 +182,15 @@ class ServeClient:
                 # TimeoutError, which subclasses OSError): count it
                 # against this replica and try the next one.
                 br.record_failure()
+                if br.state == "open":
+                    flight.record(rid, "breaker_open", replica=i)
                 self._update_breaker_gauge()
                 last = e
                 continue
             if out.get("status") in ("shed", "draining"):
                 br.record_failure()
+                if br.state == "open":
+                    flight.record(rid, "breaker_open", replica=i)
                 self._update_breaker_gauge()
                 last = f"worker {i}: {out}"
                 continue
@@ -191,7 +198,10 @@ class ServeClient:
             self._update_breaker_gauge()
             self._where[rid] = (c, sid)
             out["request_id"] = rid
+            flight.record(rid, "placed", replica=i,
+                          status=out.get("status"))
             return out
+        flight.record(rid, "overload", replicas=n)
         raise ServeOverloadError(
             f"all {n} replicas unavailable or overloaded "
             f"(last: {last})") from (last if isinstance(last, BaseException)

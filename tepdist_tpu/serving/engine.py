@@ -71,7 +71,7 @@ from tepdist_tpu.models.sampling import _split_data
 from tepdist_tpu.runtime import faults
 from tepdist_tpu.serving.kv_cache import ServableModel
 from tepdist_tpu.serving.paged_kv import PagedServableModel
-from tepdist_tpu.telemetry import metrics, span
+from tepdist_tpu.telemetry import flight, metrics, span
 
 log = logging.getLogger("tepdist.serving")
 
@@ -145,7 +145,8 @@ class ServingEngine:
                  n_pages: Optional[int] = None,
                  hbm_budget_bytes: Optional[float] = None,
                  prefix_cache: bool = True,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 gen: int = 0):
         if kv_mode not in ("paged", "slots"):
             raise ValueError(f"kv_mode must be 'paged' or 'slots', "
                              f"got {kv_mode!r}")
@@ -168,6 +169,14 @@ class ServingEngine:
         self.max_queue = int(max_queue)
         self.task_index = task_index      # fault-rule ti filter target
         self.on_fault = on_fault          # set => supervised (ladder up)
+        # Engine incarnation (supervisor restarts bump it): every flight
+        # event carries gen= so a request surviving a restart shows its
+        # history across BOTH incarnations.
+        self.gen = int(gen)
+        # Serve spans carry worker= when known so the fidelity join
+        # attributes them to a lane instead of the untagged clamp.
+        self._wtag = ({"worker": task_index} if task_index is not None
+                      else {})
         self._reqs: Dict[str, ServeRequest] = {}
         self._queue: deque = deque()
         # Resident requests in admission order (paged decode batches it;
@@ -198,16 +207,19 @@ class ServingEngine:
                 # id): never enqueue twice — this counter is the
                 # exactly-once evidence the chaos test asserts on.
                 m.counter("serve_requests_deduped").inc()
+                flight.record(rid, "dedup", gen=self.gen)
                 return {"status": "duplicate",
                         "state": self._reqs[rid].state}
             if self._dead:
                 # No record is kept: a dead engine must not claim rids
                 # the supervisor's replacement will own.
+                flight.record(rid, "reject", gen=self.gen, reason="dead")
                 return {"status": "rejected",
                         "error": f"engine dead: {self._error}"}
             if self._draining:
                 # Honest backpressure, not a terminal record: the caller
                 # resubmits the same rid on another replica.
+                flight.record(rid, "draining", gen=self.gen)
                 return {"status": "draining"}
             m.counter("serve_requests_submitted").inc()
             err = None
@@ -233,7 +245,12 @@ class ServingEngine:
                 r.state = "rejected"
                 r.error = err
                 m.counter("serve_requests_rejected").inc()
+                flight.record(rid, "reject", gen=self.gen, reason=err)
                 return {"status": "rejected", "error": err}
+            flight.record(rid, "queue", gen=self.gen,
+                          prompt_len=int(prompt.size),
+                          max_new_tokens=int(max_new_tokens),
+                          depth=len(self._queue))
             sp = span("serve:ttft", cat="serve", rid=rid,
                       prompt_len=int(prompt.size))
             sp.__enter__()
@@ -269,6 +286,7 @@ class ServingEngine:
             self._release_locked(r)
             r.state = "cancelled"
             r.t_done = time.monotonic()
+            flight.record(rid, "cancel", gen=self.gen)
             metrics().counter("serve_requests_cancelled").inc()
             self._cv.notify_all()
             return True
@@ -351,6 +369,7 @@ class ServingEngine:
                     r.error = f"deadline {r.deadline_ms} ms passed in queue"
                     r.t_done = time.monotonic()
                     m.counter("serve_requests_expired").inc()
+                    flight.record(rid, "expire", gen=self.gen)
                     self._cv.notify_all()
                     continue
                 if paged:
@@ -367,9 +386,13 @@ class ServingEngine:
                     r.table, r.prefix_tokens = att
                     r.prefilled = r.prefix_tokens
                     r.state = "prefill"
+                    flight.record(rid, "admit", gen=self.gen,
+                                  pages=len(r.table.pages),
+                                  prefix_tokens=int(r.prefix_tokens))
                 else:
                     r.slot = self.model.pool.alloc()
                     r.state = "active"
+                    flight.record(rid, "admit", gen=self.gen, slot=r.slot)
                 self._active[rid] = r
                 admitted.append(r)
             m.gauge("serve_queue_depth").set(len(self._queue))
@@ -407,7 +430,7 @@ class ServingEngine:
         if plan is not None:
             plan.serve_op("prefill", self.task_index)
         with span("serve:prefill", cat="serve", rid=r.rid, slot=r.slot,
-                  prompt_len=int(r.prompt.size)) as sp:
+                  prompt_len=int(r.prompt.size), **self._wtag) as sp:
             logits, k, v, bucket = self.model.prefill(r.prompt)
             sp.set(bucket=bucket)
             self.model.insert(k, v, r.slot)
@@ -418,10 +441,13 @@ class ServingEngine:
             tok = self.model.pick(logits, sub, r.temperature, r.top_k,
                                   r.greedy)
         m.counter("serve_prefills").inc()
+        flight.record(r.rid, "prefill", gen=self.gen,
+                      prompt_len=int(r.prompt.size))
         with self._cv:
             r.t_first = time.monotonic()
             r.tokens.append(tok)
             r.pos = int(r.prompt.size)
+            flight.record(r.rid, "first_token", gen=self.gen)
             m.counter("serve_tokens").inc()
             m.histogram("serve_ttft_ms").observe(
                 (r.t_first - r.t_submit) * 1e3)
@@ -455,8 +481,8 @@ class ServingEngine:
             self.model.extend_table(r.table, end)
             pages = list(r.table.pages)
         with span("serve:prefill", cat="serve", rid=r.rid,
-                  chunk=end - start, start=start,
-                  prompt_len=T) as sp:
+                  chunk=end - start, chunk_index=r.chunks, start=start,
+                  prompt_len=T, **self._wtag) as sp:
             logits = self.model.prefill_chunk(pages, r.prompt,
                                               start, end)
             sp.set(chunks=r.chunks + 1)
@@ -470,6 +496,8 @@ class ServingEngine:
                                       r.top_k, r.greedy)
         m.counter("prefill_chunks").inc()
         m.counter("serve_prefill_tokens").inc(end - start)
+        flight.record(r.rid, "prefill_chunk", gen=self.gen,
+                      start=start, end=end, chunk=end - start)
         with self._cv:
             if r.state != "prefill":
                 return                # cancelled mid-chunk: drop it
@@ -484,6 +512,8 @@ class ServingEngine:
             r.tokens.append(tok)
             r.pos = T
             r.state = "active"
+            flight.record(r.rid, "first_token", gen=self.gen,
+                          chunks=r.chunks)
             m.counter("serve_prefills").inc()
             m.counter("serve_tokens").inc()
             m.histogram("serve_ttft_ms").observe(
@@ -542,7 +572,8 @@ class ServingEngine:
         for sp in tok_spans:
             sp.__enter__()
         t0 = time.perf_counter()
-        with span("serve:decode", cat="serve", batch=len(batch)):
+        with span("serve:decode", cat="serve", batch=len(batch),
+                  **self._wtag):
             if paged:
                 logits = self.model.decode_batch(rows)
             else:
@@ -569,6 +600,8 @@ class ServingEngine:
                 r.pos += 1
                 r.decode_ms += step_ms
                 r.decode_steps += 1
+                flight.record(r.rid, "decode", gen=self.gen,
+                              pos=r.pos, batch=len(batch))
                 m.counter("serve_tokens").inc()
                 m.histogram("serve_token_ms").observe(step_ms)
                 if len(r.tokens) >= r.max_new_tokens:
@@ -579,6 +612,8 @@ class ServingEngine:
         self._release_locked(r)
         r.state = "done"
         r.t_done = time.monotonic()
+        flight.record(r.rid, "finish", gen=self.gen,
+                      n_tokens=len(r.tokens))
         m = metrics()
         m.counter("serve_requests_completed").inc()
         m.histogram("serve_request_ms").observe(
@@ -611,6 +646,7 @@ class ServingEngine:
             r.state = "failed"
             r.error = err
             r.t_done = time.monotonic()
+            flight.record(r.rid, "fail", gen=self.gen, reason=err)
             m.counter("serve_requests_failed").inc()
         self._queue.clear()
         if self.kv_mode == "paged":
@@ -646,6 +682,7 @@ class ServingEngine:
                 "seed": r.seed,
                 "deadline_ms": r.deadline_ms,
             })
+            flight.record(r.rid, "drain_handoff", gen=self.gen)
             m.counter("drain_handoffs").inc()
 
         with self._cv:

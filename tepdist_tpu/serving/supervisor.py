@@ -61,7 +61,7 @@ import numpy as np
 from tepdist_tpu.analysis.lockdep_runtime import make_rlock
 from tepdist_tpu.models.gpt2 import GPT2Config
 from tepdist_tpu.serving.engine import TERMINAL, ServingEngine
-from tepdist_tpu.telemetry import metrics
+from tepdist_tpu.telemetry import flight, metrics
 
 log = logging.getLogger("tepdist.serving")
 
@@ -130,6 +130,7 @@ class ServingSupervisor:
         self._lock = make_rlock("ServingSupervisor._lock")
         self._journal: Dict[str, _JournalEntry] = {}
         self._completed: Dict[str, Dict[str, Any]] = {}  # dead-gen results
+        self._delivered: set = set()   # rids whose terminal result polled
         self._shedding = False
         self._threaded = False
         self.restarts = 0
@@ -141,6 +142,7 @@ class ServingSupervisor:
         eng = ServingEngine(self._params, self._cfg,
                             task_index=self.task_index,
                             on_fault=self._on_engine_fault,
+                            gen=self.restarts,
                             **self._engine_kwargs)
         if old is not None:
             eng.model.adopt_executables(old.model)
@@ -198,6 +200,8 @@ class ServingSupervisor:
             if self._shedding or depth >= self.shed_high:
                 self._shedding = True
                 metrics().counter("serve_shed").inc()
+                flight.record(rid, "shed", depth=depth,
+                              high=self.shed_high)
                 return {"status": "shed",
                         "error": (f"queue depth {depth} over high "
                                   f"watermark {self.shed_high}")}
@@ -248,6 +252,16 @@ class ServingSupervisor:
             if rids is None:
                 out.extend(v for k, v in self._completed.items()
                            if k not in seen)
+            # Flight: exactly one "deliver" per rid, at the FIRST poll
+            # that observes its terminal result (carried or live).
+            for r in out:
+                rid = r.get("request_id")
+                if (r.get("status") in TERMINAL
+                        and rid not in self._delivered):
+                    self._delivered.add(rid)
+                    flight.record(rid, "deliver",
+                                  status=r.get("status"),
+                                  n_tokens=r.get("n_tokens", 0))
             return out
 
     def poll(self, rids: Optional[Sequence[str]] = None,
@@ -298,6 +312,8 @@ class ServingSupervisor:
                 return
             self.restarts += 1
             metrics().counter("engine_restarts").inc()
+            flight.record("*", "restart", gen=self.restarts,
+                          reason=repr(exc))
             log.warning("serving engine fault (%r): restart %d/%d",
                         exc, self.restarts, self.max_restarts)
             old.stop(timeout=0.0, drain=False)
@@ -315,6 +331,8 @@ class ServingSupervisor:
                         res["tokens"] = list(e.prefix) + res["tokens"]
                         res["n_tokens"] = len(res["tokens"])
                     self._completed[r.rid] = res
+                    flight.record(r.rid, "carry", gen=self.restarts,
+                                  status=res.get("status"))
                     continue
                 if e is None:      # pragma: no cover — journal invariant
                     continue
@@ -340,6 +358,9 @@ class ServingSupervisor:
                     top_k=e.top_k, seed=e.seed, deadline_ms=e.deadline_ms)
                 e.replays += 1
                 metrics().counter("requests_replayed").inc()
+                flight.record(e.rid, "replay", gen=self.restarts,
+                              prefix=len(e.prefix),
+                              status=out["status"])
                 if out["status"] != "queued":  # pragma: no cover
                     log.error("replay of %s not admitted: %s", e.rid, out)
             self.engine = new

@@ -38,3 +38,5 @@ from tepdist_tpu.telemetry.export import (  # noqa: F401
 )
 from tepdist_tpu.telemetry import calibrate  # noqa: F401
 from tepdist_tpu.telemetry import fidelity  # noqa: F401
+from tepdist_tpu.telemetry import flight  # noqa: F401
+from tepdist_tpu.telemetry import ledger  # noqa: F401

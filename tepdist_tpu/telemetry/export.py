@@ -22,6 +22,8 @@ import logging
 import os
 from typing import Any, Dict, Iterable, List, Optional
 
+from tepdist_tpu.telemetry import flight as _flight
+from tepdist_tpu.telemetry import ledger as _ledger
 from tepdist_tpu.telemetry.metrics import MetricsRegistry
 
 log = logging.getLogger(__name__)
@@ -68,13 +70,24 @@ def build_trace(payloads: Iterable[Dict[str, Any]],
     """
     events: List[Dict[str, Any]] = []
     snaps: List[Dict[str, Any]] = []
+    ledgers: List[Dict[str, Any]] = []
+    flights: List[List[Dict[str, Any]]] = []
     dropped: Dict[str, int] = {}
     for p in payloads:
+        off = p.get("offset_us", 0.0)
         events.extend(to_chrome_events(
-            p.get("spans", ()), pid=p["pid"],
-            offset_us=p.get("offset_us", 0.0), label=p.get("label")))
+            p.get("spans", ()), pid=p["pid"], offset_us=off,
+            label=p.get("label")))
         if p.get("metrics"):
             snaps.append(p["metrics"])
+        if p.get("ledger"):
+            # Shift onto the merge clock so the fleet ledger's step
+            # windows and intervals line up with the span timeline.
+            ledgers.append(_ledger.shift(p["ledger"], off))
+        if p.get("flight", {}).get("events"):
+            flights.append(_flight.shift(
+                p["flight"]["events"], off,
+                proc=p.get("label") or str(p["pid"])))
         if p.get("spans_dropped"):
             dropped[p.get("label") or str(p["pid"])] = int(
                 p["spans_dropped"])
@@ -82,6 +95,10 @@ def build_trace(payloads: Iterable[Dict[str, Any]],
     meta: Dict[str, Any] = {}
     if snaps:
         meta["metrics"] = MetricsRegistry.merge(snaps)
+    if ledgers:
+        meta["ledger"] = _ledger.merge(ledgers)
+    if flights:
+        meta["flight"] = _flight.merge(flights)
     if dropped:
         meta["spans_dropped"] = dropped
     if extra_metadata:
@@ -122,6 +139,8 @@ def worker_payload(client, clear: bool = False) -> Dict[str, Any]:
             "spans": h.get("spans", ()),
             "offset_us": h.get("offset_us", 0.0),
             "metrics": h.get("metrics"),
+            "ledger": h.get("ledger"),
+            "flight": h.get("flight"),
             "spans_dropped": int(h.get("spans_dropped", 0))}
 
 
@@ -134,6 +153,8 @@ def local_payload(label: str = "client") -> Dict[str, Any]:
             "spans": t.snapshot(),
             "offset_us": 0.0,
             "metrics": _metrics().snapshot(),
+            "ledger": _ledger.ledger().snapshot(),
+            "flight": _flight.recorder().snapshot(),
             "spans_dropped": t.dropped}
 
 

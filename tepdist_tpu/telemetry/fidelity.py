@@ -43,6 +43,10 @@ CAT_BUCKET = {
     "ar": "collective",
     "send": "transfer", "recv": "transfer",
     "serde": "host_serde", "input": "host_serde", "data": "host_serde",
+    # Serving spans (serve:prefill/serve:decode, PR 8's chunked prefill):
+    # model executions, so they attribute as compute instead of falling
+    # into the untagged-span clamp.
+    "serve": "compute",
 }
 # Nested spans: a serde span lives inside its send/recv span, which may
 # live inside compute-adjacent windows. Earlier buckets own overlaps.

@@ -1,0 +1,159 @@
+"""Serving flight recorder: per-request waterfall events across processes.
+
+Reference parity: NONE (deliberate surplus). The serving stack (PRs 4/5/8)
+has rich *aggregate* counters — shed totals, prefix hit rates, restart
+counts — but nothing that answers "where did THIS request's latency go?"
+This module is the per-request story: a bounded ring of tagged waterfall
+events recorded at every hop a request takes —
+
+    client:  submit, placed, overload, breaker_open
+    engine:  queue, dedup, reject, admit (pages/prefix hit), prefill,
+             prefill_chunk, first_token, decode, finish, cancel, expire,
+             fail, drain_handoff, shed
+    supervisor: restart, replay, carry, deliver
+
+Every event carries the request id (``rid``), an epoch-microsecond
+timestamp, and the engine incarnation (``gen``) where relevant — so a
+request that survives a supervised engine restart shows its exactly-once
+history across BOTH incarnations (replayed prefill under gen N+1, one
+``finish``, one ``deliver``). Events ride back in ``GetTelemetry`` next
+to spans and are merged clock-aligned by telemetry/export.py;
+``tools/request_trace.py`` renders the text waterfall and the Perfetto
+flow-arrow export.
+
+Gating: ``TEPDIST_FLIGHT`` (default ON — the ring is cheap: one dict
+append per event, no serde) with ``TEPDIST_FLIGHT_CAPACITY`` bounding
+memory. Same singleton/disabled-path contract as trace.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional
+
+
+def _now_us() -> int:
+    return time.time_ns() // 1000
+
+
+class FlightRecorder:
+    """Bounded, thread-safe ring of per-request waterfall events."""
+
+    def __init__(self, enabled: bool = True, capacity: int = 8192):
+        self.enabled = enabled
+        self.capacity = max(int(capacity), 16)
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=self.capacity)
+        self.dropped = 0
+
+    def record(self, rid: str, ev: str, **args: Any) -> None:
+        if not self.enabled:
+            return
+        entry = {"rid": rid, "ev": ev, "ts": _now_us()}
+        if args:
+            entry["args"] = args
+        with self._lock:
+            if len(self._events) >= self.capacity:
+                self.dropped += 1
+            self._events.append(entry)
+
+    def snapshot(self, clear: bool = False) -> Dict[str, Any]:
+        with self._lock:
+            out = {"enabled": self.enabled,
+                   "events": [dict(e) for e in self._events],
+                   "dropped": self.dropped}
+            if clear:
+                self._events.clear()
+                self.dropped = 0
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+
+# -- module singleton -------------------------------------------------------
+
+_RECORDER: Optional[FlightRecorder] = None
+_INIT_LOCK = threading.Lock()
+
+
+def _init_from_env() -> FlightRecorder:
+    global _RECORDER
+    with _INIT_LOCK:
+        if _RECORDER is None:
+            from tepdist_tpu.core.service_env import ServiceEnv
+            env = ServiceEnv.get()
+            _RECORDER = FlightRecorder(
+                enabled=bool(env.tepdist_flight),
+                capacity=int(env.tepdist_flight_capacity))
+    return _RECORDER
+
+
+def recorder() -> FlightRecorder:
+    rec = _RECORDER
+    if rec is None:
+        rec = _init_from_env()
+    return rec
+
+
+def configure(enabled: Optional[bool] = None,
+              capacity: Optional[int] = None) -> FlightRecorder:
+    global _RECORDER
+    rec = recorder()
+    if capacity is not None and capacity != rec.capacity:
+        rec = FlightRecorder(enabled=rec.enabled if enabled is None
+                             else enabled, capacity=capacity)
+        with _INIT_LOCK:
+            _RECORDER = rec
+    elif enabled is not None:
+        rec.enabled = enabled
+    return rec
+
+
+def record(rid: str, ev: str, **args: Any) -> None:
+    """Module-level fast path: one attribute load + one branch when off."""
+    rec = _RECORDER
+    if rec is None:
+        rec = _init_from_env()
+    if rec.enabled:
+        rec.record(rid, ev, **args)
+
+
+# -- cross-process merge ----------------------------------------------------
+
+def shift(events: Iterable[Dict[str, Any]], offset_us: float,
+          proc: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Copy events onto the caller's clock (NTP-midpoint ``offset_us``),
+    optionally stamping the source process label for merged views."""
+    out = []
+    for e in events:
+        e2 = dict(e)
+        e2["ts"] = e2.get("ts", 0) - offset_us
+        if proc is not None and "proc" not in e2:
+            e2["proc"] = proc
+        out.append(e2)
+    return out
+
+
+def merge(event_lists: Iterable[Iterable[Dict[str, Any]]]
+          ) -> List[Dict[str, Any]]:
+    """Concatenate per-process (already shifted) event lists, time-sorted."""
+    merged: List[Dict[str, Any]] = []
+    for evs in event_lists:
+        merged.extend(evs)
+    merged.sort(key=lambda e: (e.get("ts", 0), e.get("rid", ""),
+                               e.get("ev", "")))
+    return merged
+
+
+def by_request(events: Iterable[Dict[str, Any]]
+               ) -> Dict[str, List[Dict[str, Any]]]:
+    """Group a merged event list per rid, preserving time order."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        out.setdefault(e.get("rid", "?"), []).append(e)
+    return out

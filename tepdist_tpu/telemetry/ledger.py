@@ -1,0 +1,586 @@
+"""Per-verb RPC wire/serde ledger: the hot-path instrument panel.
+
+Reference parity: NONE (deliberate surplus). ROADMAP item 5 commits the
+next perf PR to the ~31 ms/step/worker of Python serde + RPC
+orchestration that the round-5 probe root-caused, and item 3 wants to
+shrink the ``host_push`` wire format — neither is attackable without a
+per-verb, per-byte, per-step baseline. This module records exactly that
+at the four transport chokepoints:
+
+* ``rpc/protocol.py`` ``pack``/``unpack`` and ``encode_literal``/
+  ``decode_literal`` — header vs blob bytes and serde wall time. Header
+  bytes are the envelope framing (magic + lengths + JSON header), blob
+  bytes the raw tensor payloads, so ``header + blob == len(frame)``
+  EXACTLY (tests assert the identity against wrapped ``pack`` calls).
+* ``rpc/client.py`` / ``rpc/inproc.py`` stub ``call`` — per-verb call
+  counts and client-side wall time (retries included).
+* ``rpc/retry.py`` — retry counts and backoff (client queue wait).
+* ``rpc/server.py`` / inproc dispatch — server handler wall time.
+
+Attribution uses a THREAD-LOCAL context (verb, side, step): the in-proc
+transport runs the servicer handler on the caller's own thread, so a
+context set around the client call is visible to the server-side
+pack/unpack with no API changes; the gRPC server handler opens its own
+server context. Frames packed outside any context land under
+``_unattributed`` — counted, never dropped.
+
+The GAP TABLE (``gap_table``) reduces the recorded intervals to a
+named-bucket decomposition of each master step window:
+
+    serde | rpc_orchestration | compute | dependency_idle | unattributed
+
+computed by interval union/difference so nested regions never double
+count: serde owns its time; handler time minus serde is execution;
+client rpc time minus (handler + serde) is pure orchestration (framing,
+retries, thread hops); ``compute`` is execution clamped to the
+single-process step time and ``dependency_idle`` the remainder (pipeline
+bubbles + per-worker dispatch). The five buckets sum to the step wall
+EXACTLY; ``unattributed`` is the honest residual the >=95% coverage
+criterion is graded on. ``reconcile`` cross-checks the serde bucket and
+step wall against PR 6's fidelity attribution.
+
+Gating: ``TEPDIST_LEDGER`` (default off). Disabled cost is one module
+attribute load + one branch per hook (same contract as trace.py's
+``_NULL_SPAN``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+_UNATTRIBUTED = "_unattributed"
+
+# Interval categories feeding the gap table.
+_CATS = ("serde", "rpc", "handler")
+
+_STAT_KEYS = ("calls", "retries", "backoff_us",
+              "tx_header_bytes", "tx_blob_bytes",
+              "rx_header_bytes", "rx_blob_bytes",
+              "encode_us", "decode_us", "client_us", "server_us")
+
+
+def _new_stats() -> Dict[str, float]:
+    return {k: 0 for k in _STAT_KEYS}
+
+
+class _Tls(threading.local):
+    verb: Optional[str] = None
+    side: str = "client"
+    step: Optional[int] = None
+
+
+_TLS = _Tls()
+
+
+class _NullCtx:
+    """Shared no-op context: the disabled-mode fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullCtx":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_CTX = _NullCtx()
+
+
+def _now_us() -> int:
+    return time.time_ns() // 1000
+
+
+class _VerbScope:
+    """Client- or server-side scope for one verb: sets the thread-local
+    context on entry, records the wall interval + per-verb time on exit.
+    The previous context is restored, so the in-proc server scope nested
+    inside the client scope inherits (and then returns) verb/step."""
+
+    __slots__ = ("_led", "_verb", "_side", "_step", "_t0",
+                 "_prev")
+
+    def __init__(self, led: "RpcLedger", verb: str, side: str,
+                 step: Optional[int]):
+        self._led = led
+        self._verb = verb
+        self._side = side
+        self._step = step
+        self._t0 = 0
+        self._prev: Tuple[Optional[str], str, Optional[int]] = (None,
+                                                                "client",
+                                                                None)
+
+    def __enter__(self) -> "_VerbScope":
+        tls = _TLS
+        self._prev = (tls.verb, tls.side, tls.step)
+        tls.verb = self._verb
+        tls.side = self._side
+        # A nested scope keeps the outer step when it has none of its own
+        # (server handler under a stepped client call).
+        if self._step is not None:
+            tls.step = self._step
+        self._t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t1 = _now_us()
+        tls = _TLS
+        tls.verb, tls.side, tls.step = self._prev
+        if self._side == "client":
+            self._led._record_call(self._verb, tls.step if
+                                   self._step is None else self._step,
+                                   self._t0, t1)
+        else:
+            self._led._record_handler(self._verb, tls.step if
+                                      self._step is None else self._step,
+                                      self._t0, t1)
+        return False
+
+
+class _StepScope:
+    """Master-side step window: brackets one fleet step and tags every
+    ledger record made on this thread with ``step``."""
+
+    __slots__ = ("_led", "_step", "_t0", "_prev")
+
+    def __init__(self, led: "RpcLedger", step: int):
+        self._led = led
+        self._step = int(step)
+        self._t0 = 0
+        self._prev: Optional[int] = None
+
+    def __enter__(self) -> "_StepScope":
+        self._prev = _TLS.step
+        _TLS.step = self._step
+        self._t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _TLS.step = self._prev
+        self._led._record_window(self._step, self._t0, _now_us())
+        return False
+
+
+class _StepHint:
+    """Tag-only context: sets the thread-local step (no window record).
+    Used where the step is known from a header but the window belongs to
+    someone else (client call dispatch, server ExecuteRemotePlan)."""
+
+    __slots__ = ("_step", "_prev")
+
+    def __init__(self, step: Optional[int]):
+        self._step = step
+        self._prev: Optional[int] = None
+
+    def __enter__(self) -> "_StepHint":
+        self._prev = _TLS.step
+        if self._step is not None:
+            _TLS.step = int(self._step)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _TLS.step = self._prev
+        return False
+
+
+class RpcLedger:
+    """Bounded, thread-safe aggregate of wire/serde activity."""
+
+    MAX_INTERVALS = 16384     # per category ring (oldest dropped+counted)
+    MAX_STEPS = 256           # per-step rollups kept
+    EXPORT_INTERVALS = 8192   # per category cap in snapshot()
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._verbs: Dict[str, Dict[str, float]] = {}
+        self._steps: "OrderedDict[int, Dict[str, Dict[str, float]]]" = \
+            OrderedDict()
+        self._windows: "OrderedDict[int, List[int]]" = OrderedDict()
+        self._ivs: Dict[str, deque] = {c: deque(maxlen=self.MAX_INTERVALS)
+                                       for c in _CATS}
+        self.dropped: Dict[str, int] = {c: 0 for c in _CATS}
+
+    # -- low-level recording (called from the transport hooks) ----------
+    def _verb_stats(self, verb: Optional[str],
+                    step: Optional[int]) -> List[Dict[str, float]]:
+        """The global per-verb row plus (when a step is known) the
+        per-step rollup row — callers add to both. Lock held by caller."""
+        verb = verb or _UNATTRIBUTED
+        rows = [self._verbs.setdefault(verb, _new_stats())]
+        if step is not None:
+            by_verb = self._steps.get(step)
+            if by_verb is None:
+                by_verb = self._steps[step] = {}
+                while len(self._steps) > self.MAX_STEPS:
+                    self._steps.popitem(last=False)
+            rows.append(by_verb.setdefault(verb, _new_stats()))
+        return rows
+
+    def _add_iv(self, cat: str, t0_us: int, t1_us: int) -> None:
+        ivs = self._ivs[cat]
+        if len(ivs) >= self.MAX_INTERVALS:
+            self.dropped[cat] += 1
+        ivs.append((t0_us, t1_us - t0_us))
+
+    def record_pack(self, header_bytes: int, blob_bytes: int,
+                    t0_us: int, t1_us: int) -> None:
+        tls = _TLS
+        with self._lock:
+            for s in self._verb_stats(tls.verb, tls.step):
+                s["tx_header_bytes"] += header_bytes
+                s["tx_blob_bytes"] += blob_bytes
+                s["encode_us"] += t1_us - t0_us
+            self._add_iv("serde", t0_us, t1_us)
+
+    def record_unpack(self, header_bytes: int, blob_bytes: int,
+                      t0_us: int, t1_us: int) -> None:
+        tls = _TLS
+        with self._lock:
+            for s in self._verb_stats(tls.verb, tls.step):
+                s["rx_header_bytes"] += header_bytes
+                s["rx_blob_bytes"] += blob_bytes
+                s["decode_us"] += t1_us - t0_us
+            self._add_iv("serde", t0_us, t1_us)
+
+    def record_encode(self, t0_us: int, t1_us: int) -> None:
+        tls = _TLS
+        with self._lock:
+            for s in self._verb_stats(tls.verb, tls.step):
+                s["encode_us"] += t1_us - t0_us
+            self._add_iv("serde", t0_us, t1_us)
+
+    def record_decode(self, t0_us: int, t1_us: int) -> None:
+        tls = _TLS
+        with self._lock:
+            for s in self._verb_stats(tls.verb, tls.step):
+                s["decode_us"] += t1_us - t0_us
+            self._add_iv("serde", t0_us, t1_us)
+
+    def record_retry(self, verb: str, backoff_s: float) -> None:
+        with self._lock:
+            for s in self._verb_stats(verb, _TLS.step):
+                s["retries"] += 1
+                s["backoff_us"] += backoff_s * 1e6
+
+    def _record_call(self, verb: str, step: Optional[int],
+                     t0_us: int, t1_us: int) -> None:
+        with self._lock:
+            for s in self._verb_stats(verb, step):
+                s["calls"] += 1
+                s["client_us"] += t1_us - t0_us
+            self._add_iv("rpc", t0_us, t1_us)
+
+    def _record_handler(self, verb: str, step: Optional[int],
+                        t0_us: int, t1_us: int) -> None:
+        with self._lock:
+            for s in self._verb_stats(verb, step):
+                s["server_us"] += t1_us - t0_us
+            self._add_iv("handler", t0_us, t1_us)
+
+    def _record_window(self, step: int, t0_us: int, t1_us: int) -> None:
+        with self._lock:
+            w = self._windows.get(step)
+            if w is None:
+                self._windows[step] = [t0_us, t1_us]
+                while len(self._windows) > self.MAX_STEPS:
+                    self._windows.popitem(last=False)
+            else:                     # re-executed step: widen the window
+                w[0] = min(w[0], t0_us)
+                w[1] = max(w[1], t1_us)
+
+    # -- export ---------------------------------------------------------
+    def snapshot(self, clear: bool = False) -> Dict[str, Any]:
+        with self._lock:
+            out = {
+                "enabled": self.enabled,
+                "verbs": {v: dict(s) for v, s in self._verbs.items()},
+                "steps": {str(k): {v: dict(s) for v, s in by.items()}
+                          for k, by in self._steps.items()},
+                "windows": {str(k): list(w)
+                            for k, w in self._windows.items()},
+                "intervals": {
+                    c: [list(iv) for iv in
+                        list(self._ivs[c])[-self.EXPORT_INTERVALS:]]
+                    for c in _CATS},
+                "intervals_dropped": dict(self.dropped),
+            }
+            if clear:
+                self._clear_locked()
+        return out
+
+    def _clear_locked(self) -> None:
+        self._verbs.clear()
+        self._steps.clear()
+        self._windows.clear()
+        for c in _CATS:
+            self._ivs[c].clear()
+            self.dropped[c] = 0
+
+    def clear(self) -> None:
+        with self._lock:
+            self._clear_locked()
+
+
+# -- module singleton (trace.py's lazy-config pattern) ----------------------
+
+_LEDGER: Optional[RpcLedger] = None
+_INIT_LOCK = threading.Lock()
+
+
+def _init_from_env() -> RpcLedger:
+    global _LEDGER
+    with _INIT_LOCK:
+        if _LEDGER is None:
+            from tepdist_tpu.core.service_env import ServiceEnv
+            _LEDGER = RpcLedger(
+                enabled=bool(ServiceEnv.get().tepdist_ledger))
+    return _LEDGER
+
+
+def ledger() -> RpcLedger:
+    led = _LEDGER
+    if led is None:
+        led = _init_from_env()
+    return led
+
+
+def configure(enabled: Optional[bool] = None) -> RpcLedger:
+    led = ledger()
+    if enabled is not None:
+        led.enabled = enabled
+    return led
+
+
+def enabled() -> bool:
+    return ledger().enabled
+
+
+def active() -> Optional[RpcLedger]:
+    """The ledger iff enabled, else None — the hot-path gate. Hooks do
+    ``led = active()`` once and skip all recording when it is None."""
+    led = _LEDGER
+    if led is None:
+        led = _init_from_env()
+    return led if led.enabled else None
+
+
+# -- scope constructors (return the shared no-op when disabled) -------------
+
+def client_scope(verb: str, step: Optional[int] = None):
+    led = active()
+    if led is None:
+        return _NULL_CTX
+    return _VerbScope(led, verb, "client", step)
+
+
+def server_scope(verb: str, step: Optional[int] = None):
+    led = active()
+    if led is None:
+        return _NULL_CTX
+    return _VerbScope(led, verb, "server", step)
+
+
+def step_scope(step: int):
+    led = active()
+    if led is None:
+        return _NULL_CTX
+    return _StepScope(led, step)
+
+
+def step_hint(step: Optional[int]):
+    if active() is None or step is None:
+        return _NULL_CTX
+    return _StepHint(step)
+
+
+# -- interval math ----------------------------------------------------------
+
+def _union_us(intervals: List[Tuple[float, float]]) -> float:
+    total, end = 0.0, None
+    for t0, t1 in sorted(intervals):
+        if end is None or t0 > end:
+            total += t1 - t0
+            end = t1
+        elif t1 > end:
+            total += t1 - end
+            end = t1
+    return total
+
+
+def _clip(ivs: Iterable[Tuple[float, float]], lo: float, hi: float
+          ) -> List[Tuple[float, float]]:
+    out = []
+    for t0, dur in ivs:
+        t1 = t0 + dur
+        if t1 <= lo or t0 >= hi:
+            continue
+        out.append((max(t0, lo), min(t1, hi)))
+    return out
+
+
+# -- the gap table ----------------------------------------------------------
+
+def gap_table(snapshot: Dict[str, Any],
+              single_step_ms: Optional[float] = None) -> Dict[str, Any]:
+    """Reduce a ledger snapshot to the named-bucket decomposition of each
+    recorded step window. Buckets sum to the window EXACTLY (interval
+    set algebra, not sampled estimates); ``coverage`` is the attributed
+    fraction (1 - unattributed/wall). ``single_step_ms`` (the
+    single-process step time) splits execution into compute vs
+    dependency_idle; without it the two ride together as compute."""
+    ivs = {c: [tuple(iv) for iv in snapshot.get("intervals", {}).get(c, ())]
+           for c in _CATS}
+    rows: List[Dict[str, Any]] = []
+    for key, (lo, hi) in sorted(
+            ((int(k), tuple(v)) for k, v
+             in (snapshot.get("windows") or {}).items())):
+        wall_us = hi - lo
+        if wall_us <= 0:
+            continue
+        S = _clip(ivs["serde"], lo, hi)
+        H = _clip(ivs["handler"], lo, hi)
+        R = _clip(ivs["rpc"], lo, hi)
+        u_s = _union_us(S)
+        u_hs = _union_us(H + S)
+        u_rhs = _union_us(R + H + S)
+        serde_us = u_s
+        exec_us = u_hs - u_s
+        orch_us = u_rhs - u_hs
+        unattributed_us = max(wall_us - u_rhs, 0.0)
+        if single_step_ms is not None:
+            compute_us = min(single_step_ms * 1e3, exec_us)
+            idle_us = exec_us - compute_us
+        else:
+            compute_us, idle_us = exec_us, 0.0
+        row = {
+            "step": key,
+            "wall_ms": round(wall_us / 1e3, 3),
+            "buckets": {
+                "serde_ms": round(serde_us / 1e3, 3),
+                "rpc_orchestration_ms": round(orch_us / 1e3, 3),
+                "compute_ms": round(compute_us / 1e3, 3),
+                "dependency_idle_ms": round(idle_us / 1e3, 3),
+                "unattributed_ms": round(unattributed_us / 1e3, 3),
+            },
+            "coverage": round(u_rhs / wall_us, 4),
+        }
+        if single_step_ms is not None:
+            row["gap_ms"] = round(wall_us / 1e3 - single_step_ms, 3)
+        rows.append(row)
+    agg: Optional[Dict[str, Any]] = None
+    # Steady state: the first window carries compile/warm-up; aggregate
+    # over the rest when there is a rest.
+    steady = rows[1:] if len(rows) > 1 else rows
+    if steady:
+        n = len(steady)
+        agg = {
+            "n_steps": n,
+            "wall_ms": round(sum(r["wall_ms"] for r in steady) / n, 3),
+            "buckets": {k: round(sum(r["buckets"][k] for r in steady) / n,
+                                 3)
+                        for k in steady[0]["buckets"]},
+            "coverage": round(sum(r["coverage"] for r in steady) / n, 4),
+        }
+        if single_step_ms is not None:
+            agg["single_step_ms"] = round(single_step_ms, 3)
+            agg["gap_ms"] = round(agg["wall_ms"] - single_step_ms, 3)
+    return {"steps": rows, "aggregate": agg}
+
+
+def reconcile(table: Dict[str, Any],
+              attribution: Dict[str, Dict[str, float]],
+              measured_step_ms: Optional[float] = None,
+              tolerance: float = 0.10) -> Dict[str, Any]:
+    """Cross-check the ledger's gap table against PR 6's fidelity
+    attribution (telemetry/fidelity.py) — two independent measurements
+    of the same step. Compared: the serde bucket (ledger hook timing vs
+    serde-span union) and the step wall (ledger window vs the fidelity
+    report's measured step). ``rel`` is the relative disagreement on the
+    larger of each pair; ``ok`` gates on ``tolerance``."""
+    agg = table.get("aggregate") or {}
+
+    def rel(a: Optional[float], b: Optional[float]) -> Optional[float]:
+        if a is None or b is None:
+            return None
+        hi = max(abs(a), abs(b))
+        return round(abs(a - b) / hi, 4) if hi > 1e-9 else 0.0
+
+    fid_serde = sum(lane.get("host_serde_ms", 0.0)
+                    for lane in attribution.values())
+    led_serde = (agg.get("buckets") or {}).get("serde_ms")
+    out: Dict[str, Any] = {
+        "serde": {"ledger_ms": led_serde,
+                  "fidelity_ms": round(fid_serde, 3),
+                  "rel": rel(led_serde, fid_serde)},
+        "tolerance": tolerance,
+    }
+    if measured_step_ms is not None:
+        out["step_wall"] = {"ledger_ms": agg.get("wall_ms"),
+                            "fidelity_ms": measured_step_ms,
+                            "rel": rel(agg.get("wall_ms"),
+                                       measured_step_ms)}
+    rels = [v["rel"] for v in out.values()
+            if isinstance(v, dict) and v.get("rel") is not None]
+    out["ok"] = bool(rels) and all(r <= tolerance for r in rels)
+    return out
+
+
+# -- cross-process merge ----------------------------------------------------
+
+def shift(snapshot: Dict[str, Any], offset_us: float) -> Dict[str, Any]:
+    """Return a copy with every timestamp moved onto the caller's clock
+    (``offset_us`` from the NTP-midpoint estimate, telemetry/export.py)."""
+    if not offset_us:
+        return snapshot
+    out = dict(snapshot)
+    out["windows"] = {k: [w[0] - offset_us, w[1] - offset_us]
+                      for k, w in (snapshot.get("windows") or {}).items()}
+    out["intervals"] = {
+        c: [[iv[0] - offset_us, iv[1]] for iv in ivs]
+        for c, ivs in (snapshot.get("intervals") or {}).items()}
+    return out
+
+
+def merge(snapshots: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold per-process snapshots (already ``shift``-ed onto one clock)
+    into a fleet view: verb stats add, step rollups add, windows widen,
+    interval lists concatenate."""
+    verbs: Dict[str, Dict[str, float]] = {}
+    steps: Dict[str, Dict[str, Dict[str, float]]] = {}
+    windows: Dict[str, List[float]] = {}
+    intervals: Dict[str, List[List[float]]] = {c: [] for c in _CATS}
+    dropped: Dict[str, int] = {c: 0 for c in _CATS}
+    any_enabled = False
+    for snap in snapshots:
+        if not snap:
+            continue
+        any_enabled = any_enabled or bool(snap.get("enabled"))
+        for v, s in (snap.get("verbs") or {}).items():
+            row = verbs.setdefault(v, _new_stats())
+            for k in _STAT_KEYS:
+                row[k] += s.get(k, 0)
+        for st, by in (snap.get("steps") or {}).items():
+            dst = steps.setdefault(st, {})
+            for v, s in by.items():
+                row = dst.setdefault(v, _new_stats())
+                for k in _STAT_KEYS:
+                    row[k] += s.get(k, 0)
+        for st, w in (snap.get("windows") or {}).items():
+            cur = windows.get(st)
+            if cur is None:
+                windows[st] = list(w)
+            else:
+                cur[0] = min(cur[0], w[0])
+                cur[1] = max(cur[1], w[1])
+        for c in _CATS:
+            intervals[c].extend(
+                (snap.get("intervals") or {}).get(c, ()))
+            dropped[c] += (snap.get("intervals_dropped") or {}).get(c, 0)
+    return {"enabled": any_enabled, "verbs": verbs, "steps": steps,
+            "windows": windows, "intervals": intervals,
+            "intervals_dropped": dropped}

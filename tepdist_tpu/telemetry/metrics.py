@@ -99,11 +99,16 @@ class Histogram:
                     self._reservoir[j] = v
 
     def to_dict(self) -> Dict[str, Any]:
-        mean = self.sum / self.count if self.count else 0.0
+        # Every field read under the histogram lock: a concurrent
+        # observe() must not let count/sum/mean disagree in one snapshot
+        # (mean*count == sum must hold exactly for the consumer).
         with self._lock:
+            count, total = self.count, self.sum
+            lo, hi = self.min, self.max
             sample = sorted(self._reservoir)
-        return {"count": self.count, "sum": self.sum, "mean": mean,
-                "min": self.min, "max": self.max,
+        mean = total / count if count else 0.0
+        return {"count": count, "sum": total, "mean": mean,
+                "min": lo, "max": hi,
                 "p50": _quantile(sample, 0.50),
                 "p95": _quantile(sample, 0.95),
                 "p99": _quantile(sample, 0.99),
@@ -141,13 +146,27 @@ class MetricsRegistry:
         return h
 
     def snapshot(self) -> Dict[str, Any]:
+        """One CONSISTENT snapshot: the metric maps are copied under the
+        registry lock, then each metric is read under its own lock
+        (Counter.value behind ``_lock``; Gauge assignment is atomic;
+        ``Histogram.to_dict`` locks internally) — a worker thread
+        mutating mid-snapshot can no longer produce a histogram whose
+        count, sum, and mean disagree. ``to_prometheus`` consumes this
+        same snapshot (telemetry/export.py)."""
         with self._lock:
-            return {
-                "counters": {k: c.value for k, c in self._counters.items()},
-                "gauges": {k: g.value for k, g in self._gauges.items()},
-                "histograms": {k: h.to_dict()
-                               for k, h in self._histograms.items()},
-            }
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+
+        def _counter_value(c: Counter) -> int:
+            with c._lock:
+                return c.value
+
+        return {
+            "counters": {k: _counter_value(c) for k, c in counters.items()},
+            "gauges": {k: g.value for k, g in gauges.items()},
+            "histograms": {k: h.to_dict() for k, h in histograms.items()},
+        }
 
     def reset(self) -> None:
         with self._lock:

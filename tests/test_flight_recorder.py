@@ -1,0 +1,169 @@
+"""Flight-recorder tests (telemetry/flight.py): the exactly-once story
+across a supervised engine restart, ring bounding, the disabled no-op
+path, and the threaded metrics-snapshot consistency contract the
+observability stack leans on.
+
+The live restart test is the PR-9 acceptance gate: a request that rides
+across an injected ``engine_crash`` must show events under BOTH engine
+incarnations with exactly one ``finish`` and exactly one ``deliver``.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from tepdist_tpu.models import gpt2
+from tepdist_tpu.runtime import faults
+from tepdist_tpu.serving import ServingSupervisor
+from tepdist_tpu.telemetry import MetricsRegistry
+from tepdist_tpu.telemetry import flight as flight_mod
+from tepdist_tpu.telemetry.flight import FlightRecorder
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos]
+
+CFG = gpt2.CONFIGS["test"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2.init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    faults.configure(None)
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def private_recorder():
+    """Fresh enabled recorder swapped in for the module global, so the
+    assertions see only this test's events."""
+    prev = flight_mod.recorder()
+    rec = FlightRecorder(enabled=True, capacity=8192)
+    flight_mod._RECORDER = rec
+    yield rec
+    flight_mod._RECORDER = prev
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: exactly-once events across an injected engine restart
+
+
+def test_exactly_once_across_engine_restart(params, private_recorder):
+    sup = ServingSupervisor(params, CFG, slots=2, max_len=32)
+    rng = np.random.RandomState(0)
+    rids = [f"r{i}" for i in range(3)]
+    for rid in rids:
+        sup.submit(rid,
+                   rng.randint(1, CFG.vocab_size, size=5).astype(np.int32),
+                   max_new_tokens=6)
+    faults.configure("engine_crash:step=2")
+    try:
+        sup.run_until_idle()
+    finally:
+        faults.reset()
+    results = sup.poll()
+    assert {r["request_id"] for r in results} == set(rids)
+
+    snap = private_recorder.snapshot()
+    assert snap["dropped"] == 0
+    groups = flight_mod.by_request(snap["events"])
+
+    # The supervisor logged the restart itself (rid "*", new gen).
+    restart_gens = [(e.get("args") or {}).get("gen")
+                    for e in groups.get("*", ()) if e["ev"] == "restart"]
+    assert restart_gens == [1]
+
+    replayed = 0
+    for rid in rids:
+        evs = groups[rid]
+        by_ev = {}
+        for e in evs:
+            by_ev.setdefault(e["ev"], []).append(e)
+        gens = {(e.get("args") or {}).get("gen") for e in evs
+                if (e.get("args") or {}).get("gen") is not None}
+        # The crash hits at step 2 with all three requests in flight:
+        # every one of them spans both engine incarnations...
+        assert gens == {0, 1}, f"{rid}: expected both gens, got {gens}"
+        # ...yet terminates exactly once, and is delivered exactly once.
+        assert len(by_ev["finish"]) == 1, f"{rid}: {by_ev}"
+        assert len(by_ev["deliver"]) == 1, f"{rid}: {by_ev}"
+        assert by_ev["finish"][0]["args"]["gen"] == 1
+        # Event order tells the story: the lifecycle starts at submit
+        # (or engine queue) and ends with the post-restart delivery.
+        assert evs[-1]["ev"] == "deliver"
+        replayed += len(by_ev.get("replay", []))
+    # The crash interrupted in-flight work: something was replayed.
+    assert replayed >= 1
+
+
+# ---------------------------------------------------------------------------
+# Ring mechanics
+
+
+def test_ring_bounds_and_counts_drops():
+    rec = FlightRecorder(enabled=True, capacity=16)  # 16 = floor
+    assert rec.capacity == 16
+    for i in range(20):
+        rec.record(f"r{i}", "submit", seq=i)
+    snap = rec.snapshot()
+    assert len(snap["events"]) == 16
+    assert snap["dropped"] == 4
+    # Oldest evicted: the survivors are the newest sixteen.
+    assert [e["args"]["seq"] for e in snap["events"]] == list(range(4, 20))
+
+
+def test_snapshot_clear_resets_ring():
+    rec = FlightRecorder(enabled=True, capacity=16)
+    rec.record("r0", "submit")
+    assert len(rec.snapshot(clear=True)["events"]) == 1
+    assert rec.snapshot()["events"] == []
+
+
+def test_disabled_module_record_is_noop(private_recorder):
+    flight_mod.configure(enabled=False)
+    flight_mod.record("r0", "submit")
+    assert private_recorder.snapshot()["events"] == []
+
+
+def test_configure_capacity_swaps_recorder(private_recorder):
+    rec = flight_mod.configure(capacity=16)
+    assert rec is not private_recorder and rec.capacity == 16
+    for i in range(17):
+        flight_mod.record(f"r{i}", "x")
+    snap = flight_mod.recorder().snapshot()
+    assert len(snap["events"]) == 16 and snap["dropped"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Metrics snapshot consistency under concurrent observers
+
+
+def test_histogram_snapshot_consistent_under_threads():
+    """Hammer one histogram from several threads while snapshotting:
+    every snapshot must satisfy mean * count == sum exactly — a torn
+    read (count bumped before sum) would break the invariant."""
+    reg = MetricsRegistry()
+    h = reg.histogram("hammer_ms")
+    stop = threading.Event()
+
+    def worker():
+        while not stop.is_set():
+            h.observe(3.0)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            s = reg.snapshot()["histograms"]["hammer_ms"]
+            assert s["mean"] * s["count"] == pytest.approx(s["sum"])
+            assert s["sum"] == pytest.approx(3.0 * s["count"])
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()

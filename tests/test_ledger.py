@@ -1,0 +1,340 @@
+"""Per-verb RPC ledger tests (telemetry/ledger.py + the transport hooks).
+
+Covers the PR-9 acceptance points that are checkable without a live
+fleet: EXACT byte accounting on the in-proc transport (ledger tx/rx
+totals equal the sum of ``pack()`` frame sizes for a scripted session),
+the gap-table bucket algebra (buckets sum to the step wall exactly),
+reconciliation against a fidelity attribution, the disabled no-op
+contract, cross-process shift/merge, and merged-trace clock alignment
+under skewed worker clocks (spans + ledger + flight all land on the
+caller's clock).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tepdist_tpu.rpc import inproc, protocol
+from tepdist_tpu.telemetry import build_trace
+from tepdist_tpu.telemetry import flight as flight_mod
+from tepdist_tpu.telemetry import ledger as ledger_mod
+from tepdist_tpu.telemetry.ledger import RpcLedger
+
+
+@pytest.fixture()
+def private_ledger():
+    """Swap a private enabled ledger in for the module global so tests
+    neither observe nor disturb the process-wide one (mirrors the
+    private_tracer fixture in test_telemetry.py)."""
+    prev = ledger_mod.ledger()
+    led = RpcLedger(enabled=True)
+    ledger_mod._LEDGER = led
+    yield led
+    ledger_mod._LEDGER = prev
+
+
+# ---------------------------------------------------------------------------
+# Exact byte accounting on the in-proc transport
+
+
+class _EchoServicer:
+    """Minimal servicer: unpacks the request, packs a reply whose blobs
+    are the request's reversed — every byte crosses pack/unpack twice."""
+
+    task_index = 0
+
+    def Ping(self, payload, _ctx):
+        header, blobs = protocol.unpack(payload)
+        return protocol.pack({"ok": True, "echo": header.get("seq")},
+                             [b[::-1] for b in blobs])
+
+
+def test_inproc_byte_accounting_is_exact(private_ledger, monkeypatch):
+    """Sum of ledger tx bytes (header + blob, all verbs) must equal the
+    sum of ``len(pack(...))`` over every frame built during a scripted
+    in-proc session — and rx likewise against every ``unpack()`` input.
+    No sampling, no estimates."""
+    packed, unpacked = [], []
+    real_pack, real_unpack = protocol.pack, protocol.unpack
+
+    def counting_pack(header, blobs=()):
+        frame = real_pack(header, blobs)
+        packed.append(len(frame))
+        return frame
+
+    def counting_unpack(data):
+        unpacked.append(len(data))
+        return real_unpack(data)
+
+    monkeypatch.setattr(protocol, "pack", counting_pack)
+    monkeypatch.setattr(protocol, "unpack", counting_unpack)
+
+    addr = "inproc:ledger-bytes-test"
+    inproc.register_servicer(addr, _EchoServicer())
+    try:
+        stub = inproc.InProcStub(addr)
+        rng = np.random.RandomState(0)
+        for seq in range(5):
+            blobs = [rng.bytes(sz) for sz in (0, 17, 1024 * (seq + 1))]
+            payload = protocol.pack({"op": "echo", "seq": seq}, blobs)
+            resp = stub.call("Ping", payload)
+            header, out_blobs = protocol.unpack(resp)
+            assert header["echo"] == seq
+            assert [b[::-1] for b in out_blobs] == blobs
+    finally:
+        inproc.unregister_servicer(addr)
+
+    snap = private_ledger.snapshot()
+    tx = sum(s["tx_header_bytes"] + s["tx_blob_bytes"]
+             for s in snap["verbs"].values())
+    rx = sum(s["rx_header_bytes"] + s["rx_blob_bytes"]
+             for s in snap["verbs"].values())
+    assert tx == sum(packed)          # exact, to the byte
+    assert rx == sum(unpacked)
+    assert tx == rx                   # everything packed got unpacked
+
+    # The in-proc handler nests inside the client scope: both sides of
+    # the verb are accounted, and the serde work split between the
+    # request (client verb context) and the reply (server verb context)
+    # still sums to the whole wire volume above.
+    ping = snap["verbs"]["Ping"]
+    assert ping["calls"] == 5
+    assert ping["client_us"] > 0 and ping["server_us"] > 0
+    assert snap["intervals"]["rpc"] and snap["intervals"]["handler"]
+    assert snap["intervals"]["serde"]
+
+
+def test_blob_header_split_matches_frame_layout(private_ledger):
+    """tx_blob_bytes is exactly the raw blob payload; tx_header_bytes is
+    the envelope overhead (magic + framing + JSON)."""
+    blobs = [b"x" * 100, b"y" * 50]
+    frame = protocol.pack({"a": 1}, blobs)
+    v = private_ledger.snapshot()["verbs"]["_unattributed"]
+    assert v["tx_blob_bytes"] == 150
+    assert v["tx_header_bytes"] == len(frame) - 150
+
+
+# ---------------------------------------------------------------------------
+# Step rollups + scopes
+
+
+def test_step_scope_tags_and_windows(private_ledger):
+    with ledger_mod.step_scope(7):
+        with ledger_mod.client_scope("Verb"):
+            protocol.pack({"h": 1}, [b"abc"])
+    snap = private_ledger.snapshot()
+    assert snap["verbs"]["Verb"]["calls"] == 1
+    assert snap["steps"]["7"]["Verb"]["tx_blob_bytes"] == 3
+    lo, hi = snap["windows"]["7"]
+    assert hi > lo
+    # Re-executing a step widens its window rather than replacing it.
+    with ledger_mod.step_scope(7):
+        pass
+    lo2, hi2 = private_ledger.snapshot()["windows"]["7"]
+    assert lo2 == lo and hi2 >= hi
+
+
+def test_retry_accounting_converts_backoff_to_us(private_ledger):
+    private_ledger.record_retry("Flaky", 0.25)
+    private_ledger.record_retry("Flaky", 0.5)
+    v = private_ledger.snapshot()["verbs"]["Flaky"]
+    assert v["retries"] == 2
+    assert v["backoff_us"] == pytest.approx(0.75e6)
+
+
+def test_disabled_ledger_records_nothing(private_ledger):
+    ledger_mod.configure(enabled=False)
+    assert ledger_mod.active() is None
+    with ledger_mod.client_scope("Verb"):
+        protocol.pack({"h": 1}, [b"abc"])
+    snap = private_ledger.snapshot()
+    assert snap["verbs"] == {} and snap["steps"] == {}
+
+
+def test_interval_ring_is_bounded():
+    class Tiny(RpcLedger):
+        MAX_INTERVALS = 4
+
+    led = Tiny(enabled=True)
+    for i in range(10):
+        led._add_iv("serde", i, i + 1)
+    snap = led.snapshot()
+    assert len(snap["intervals"]["serde"]) == 4
+    assert snap["intervals_dropped"]["serde"] == 6
+    # Oldest dropped: the survivors are the newest four.
+    assert snap["intervals"]["serde"][0][0] == 6
+
+
+# ---------------------------------------------------------------------------
+# Gap-table bucket algebra (synthetic intervals, exact expectations)
+
+
+def _synthetic_snapshot():
+    # One step window [0, 10000] us. Serde 0-1000 and 1500-2000 (1.5ms);
+    # handler 1000-6000 but overlapping serde 1500-2000 (exec = 5000 -
+    # 500 = 4.5ms); rpc 0-7000 covering both (orch = 7000 - 6000 = 1ms);
+    # unattributed tail 7000-10000 (3ms).
+    return {
+        "enabled": True,
+        "verbs": {},
+        "steps": {},
+        "windows": {"0": [0, 10000], "1": [10000, 20000]},
+        "intervals": {
+            "serde": [[0, 1000], [1500, 500],
+                      [10000, 1000], [11500, 500]],
+            "handler": [[1000, 5000], [11000, 5000]],
+            "rpc": [[0, 7000], [10000, 7000]],
+        },
+        "intervals_dropped": {"serde": 0, "handler": 0, "rpc": 0},
+    }
+
+
+def test_gap_table_buckets_sum_to_wall_exactly():
+    table = ledger_mod.gap_table(_synthetic_snapshot())
+    assert len(table["steps"]) == 2
+    for row in table["steps"]:
+        b = row["buckets"]
+        assert b["serde_ms"] == pytest.approx(1.5)
+        assert b["compute_ms"] == pytest.approx(4.5)  # exec, no split
+        assert b["dependency_idle_ms"] == 0.0
+        assert b["rpc_orchestration_ms"] == pytest.approx(1.0)
+        assert b["unattributed_ms"] == pytest.approx(3.0)
+        assert sum(b.values()) == pytest.approx(row["wall_ms"])
+        assert row["coverage"] == pytest.approx(0.7)
+
+
+def test_gap_table_compute_idle_split_with_single_step_time():
+    # single-process step = 3ms; exec union is 4.5ms -> 1.5ms idle.
+    table = ledger_mod.gap_table(_synthetic_snapshot(), single_step_ms=3.0)
+    row = table["steps"][0]
+    assert row["buckets"]["compute_ms"] == pytest.approx(3.0)
+    assert row["buckets"]["dependency_idle_ms"] == pytest.approx(1.5)
+    assert row["gap_ms"] == pytest.approx(10.0 - 3.0)
+    # Aggregate skips the warm-up row when there is more than one.
+    agg = table["aggregate"]
+    assert agg["n_steps"] == 1
+    assert agg["single_step_ms"] == 3.0
+    assert sum(agg["buckets"].values()) == pytest.approx(agg["wall_ms"])
+
+
+def test_reconcile_against_fidelity_attribution():
+    table = ledger_mod.gap_table(_synthetic_snapshot())
+    # Fidelity lanes within 10% of the ledger's 1.5ms serde bucket: ok.
+    good = {"w0": {"host_serde_ms": 0.8}, "w1": {"host_serde_ms": 0.65}}
+    rec = ledger_mod.reconcile(table, good, measured_step_ms=10.0)
+    assert rec["ok"]
+    assert rec["serde"]["rel"] <= 0.10
+    assert rec["step_wall"]["rel"] <= 0.10
+    # A 2x disagreement on serde trips it.
+    bad = {"w0": {"host_serde_ms": 3.0}}
+    assert not ledger_mod.reconcile(table, bad, measured_step_ms=10.0)["ok"]
+    # As does a step-wall mismatch even when serde agrees.
+    assert not ledger_mod.reconcile(table, good,
+                                    measured_step_ms=20.0)["ok"]
+
+
+# ---------------------------------------------------------------------------
+# Cross-process shift + merge
+
+
+def test_shift_moves_windows_and_intervals():
+    snap = _synthetic_snapshot()
+    shifted = ledger_mod.shift(snap, 500.0)
+    assert shifted["windows"]["0"] == [-500.0, 9500.0]
+    assert shifted["intervals"]["serde"][0] == [-500.0, 1000]  # dur kept
+    assert ledger_mod.shift(snap, 0.0) is snap                 # no copy
+
+
+def test_merge_adds_stats_and_widens_windows():
+    a = {"enabled": True,
+         "verbs": {"V": dict(ledger_mod._new_stats(), calls=2,
+                             tx_blob_bytes=10)},
+         "steps": {"0": {"V": dict(ledger_mod._new_stats(), calls=2)}},
+         "windows": {"0": [100, 200]},
+         "intervals": {"serde": [[100, 10]], "handler": [], "rpc": []},
+         "intervals_dropped": {"serde": 1, "handler": 0, "rpc": 0}}
+    b = {"enabled": False,
+         "verbs": {"V": dict(ledger_mod._new_stats(), calls=3,
+                             tx_blob_bytes=5)},
+         "steps": {"0": {"V": dict(ledger_mod._new_stats(), calls=3)}},
+         "windows": {"0": [50, 150]},
+         "intervals": {"serde": [[50, 10]], "handler": [], "rpc": []},
+         "intervals_dropped": {"serde": 0, "handler": 0, "rpc": 2}}
+    m = ledger_mod.merge([a, b])
+    assert m["enabled"] is True
+    assert m["verbs"]["V"]["calls"] == 5
+    assert m["verbs"]["V"]["tx_blob_bytes"] == 15
+    assert m["steps"]["0"]["V"]["calls"] == 5
+    assert m["windows"]["0"] == [50, 200]
+    assert len(m["intervals"]["serde"]) == 2
+    assert m["intervals_dropped"] == {"serde": 1, "handler": 0, "rpc": 2}
+
+
+# ---------------------------------------------------------------------------
+# Merged fleet trace: clock alignment under skewed worker clocks
+
+
+def test_merged_trace_clock_alignment_under_skew():
+    """A worker whose clock runs 500us AHEAD reports spans, ledger
+    windows/intervals, and flight events all 500us late; build_trace must
+    subtract its offset so every stream of both processes lands on the
+    caller's clock — the same instant reads the same timestamp
+    everywhere in the merged trace."""
+    skew = 500.0  # worker clock = client clock + skew
+
+    local = {
+        "pid": -1, "label": "client", "offset_us": 0.0,
+        "spans": [{"name": "step", "cat": "step", "ts": 1000.0,
+                   "dur": 1000.0}],
+        "metrics": None,
+        "ledger": {"enabled": True, "verbs": {}, "steps": {},
+                   "windows": {"0": [1000.0, 2000.0]},
+                   "intervals": {"serde": [[1200.0, 100.0]],
+                                 "handler": [], "rpc": []},
+                   "intervals_dropped": {}},
+        "flight": {"enabled": True, "dropped": 0,
+                   "events": [{"rid": "r1", "ev": "submit",
+                               "ts": 1500.0, "args": {}}]},
+        "spans_dropped": 0,
+    }
+    # Same true instants, observed on the skewed worker clock.
+    worker = {
+        "pid": 0, "label": "worker0", "offset_us": skew,
+        "spans": [{"name": "run_step", "cat": "compute",
+                   "ts": 1000.0 + skew, "dur": 1000.0}],
+        "metrics": None,
+        "ledger": {"enabled": True, "verbs": {}, "steps": {},
+                   "windows": {"0": [1000.0 + skew, 2000.0 + skew]},
+                   "intervals": {"serde": [[1200.0 + skew, 100.0]],
+                                 "handler": [], "rpc": []},
+                   "intervals_dropped": {}},
+        "flight": {"enabled": True, "dropped": 0,
+                   "events": [{"rid": "r1", "ev": "admit",
+                               "ts": 1600.0 + skew, "args": {}}]},
+        "spans_dropped": 0,
+    }
+
+    trace = build_trace([local, worker])
+
+    spans = {e["pid"]: e for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert spans[-1]["ts"] == pytest.approx(1000.0)
+    assert spans[0]["ts"] == pytest.approx(1000.0)  # skew removed
+
+    led = trace["metadata"]["ledger"]
+    # Both processes observed the same step window: after alignment the
+    # merged (widened) window is still exactly [1000, 2000].
+    assert led["windows"]["0"] == pytest.approx([1000.0, 2000.0])
+    starts = sorted(iv[0] for iv in led["intervals"]["serde"])
+    assert starts == pytest.approx([1200.0, 1200.0])
+
+    flights = trace["metadata"]["flight"]
+    assert [e["ts"] for e in flights] == pytest.approx([1500.0, 1600.0])
+    procs = {e["proc"] for e in flights}
+    assert procs == {"client", "worker0"}
+    # Grouping by request sees one coherent two-hop story.
+    grouped = flight_mod.by_request(flights)
+    assert [e["ev"] for e in grouped["r1"]] == ["submit", "admit"]

@@ -1,0 +1,191 @@
+"""tools/perf_gate.py tests: history recording, the rolling
+median-of-k + MAD baseline, direction inference, the seeded-regression
+self-test (which must trip the gate WITHOUT polluting history), and the
+bench_extra.json / serve_load-summary flatteners."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import perf_gate  # noqa: E402
+
+
+@pytest.fixture()
+def history(tmp_path):
+    return str(tmp_path / "bench_history.jsonl")
+
+
+def _seed(history, values_list):
+    for vals in values_list:
+        perf_gate.append_history(history, vals)
+
+
+# ---------------------------------------------------------------------------
+# Flatteners
+
+
+def test_flatten_records_promotes_nested_measurements():
+    records = [
+        {"metric": "two_worker_overhead_x", "value": 1.4,
+         "two_worker_fleet_ms": 103.2, "task_graph_ms": 71.9,
+         "unit": "x", "note": "text stays out"},
+        {"metric": "plan_verify_ms", "value": 3.1, "checks": 12},
+        {"metric": "broken", "value": "n/a"},
+    ]
+    flat = perf_gate.flatten_records(records)
+    assert flat["two_worker_overhead_x"] == 1.4
+    assert flat["two_worker_fleet_ms"] == 103.2       # promoted
+    assert flat["task_graph_ms"] == 71.9
+    assert flat["plan_verify_ms"] == 3.1
+    assert "checks" not in flat                        # no suffix match
+    assert "broken" not in flat                        # non-numeric value
+    assert "unit" not in flat and "note" not in flat
+
+
+def test_serve_json_values_reads_nested_ttft():
+    summary = {"tokens_per_s": 812.5,
+               "ttft_ms": {"mean": 9.0, "p50": 8.1, "p95": 14.2},
+               "requests": 64}
+    vals = perf_gate.serve_json_values(summary)
+    assert vals == {"serving_tok_s": 812.5,
+                    "serving_ttft_ms_p50": 8.1,
+                    "serving_ttft_ms_p95": 14.2}
+
+
+def test_direction_inference():
+    assert perf_gate.higher_is_better("serving_tok_s")
+    assert perf_gate.higher_is_better("paged_capacity_x")
+    assert not perf_gate.higher_is_better("two_worker_fleet_ms")
+    assert not perf_gate.higher_is_better("plan_verify_ms")
+
+
+# ---------------------------------------------------------------------------
+# Baseline + check
+
+
+def test_check_passes_on_stable_history(history):
+    _seed(history, [{"two_worker_fleet_ms": v}
+                    for v in (100.0, 102.0, 98.0, 101.0, 99.0)])
+    rc = perf_gate.main(["--history", history, "--check",
+                         "--keys", "two_worker_fleet_ms",
+                         "--record-value", "two_worker_fleet_ms=103.0"])
+    assert rc == 0
+
+
+def test_check_fails_on_regression_and_improvement_passes(history):
+    _seed(history, [{"two_worker_fleet_ms": v}
+                    for v in (100.0, 102.0, 98.0, 101.0, 99.0)])
+    rc = perf_gate.main(["--history", history, "--check",
+                         "--keys", "two_worker_fleet_ms",
+                         "--record-value", "two_worker_fleet_ms=150.0"])
+    assert rc == 1
+    # A big IMPROVEMENT (lower ms) never fails a lower-is-better key.
+    rc = perf_gate.main(["--history", history, "--check",
+                         "--keys", "two_worker_fleet_ms",
+                         "--record-value", "two_worker_fleet_ms=50.0"])
+    assert rc == 0
+
+
+def test_higher_better_direction_flips_the_gate(history):
+    _seed(history, [{"serving_tok_s": v}
+                    for v in (800.0, 820.0, 790.0, 810.0)])
+    assert perf_gate.main(["--history", history, "--check",
+                           "--keys", "serving_tok_s",
+                           "--record-value",
+                           "serving_tok_s=500.0"]) == 1
+    assert perf_gate.main(["--history", history, "--check",
+                           "--keys", "serving_tok_s",
+                           "--record-value",
+                           "serving_tok_s=1000.0"]) == 0
+
+
+def test_thin_history_never_fails(history):
+    _seed(history, [{"two_worker_fleet_ms": 100.0}])   # n=1 < min 3
+    rc = perf_gate.main(["--history", history, "--check",
+                         "--keys", "two_worker_fleet_ms,missing_key_ms",
+                         "--record-value",
+                         "two_worker_fleet_ms=500.0"])
+    assert rc == 0
+    rows = perf_gate.check_values(
+        {"two_worker_fleet_ms": 500.0},
+        perf_gate.read_history(history)[:-1],
+        keys=("two_worker_fleet_ms", "missing_key_ms"))
+    assert rows[0]["verdict"] == "no-baseline"
+    assert rows[1]["verdict"] == "missing"
+
+
+def test_seeded_regression_trips_gate_without_polluting_history(history):
+    _seed(history, [{"two_worker_fleet_ms": v}
+                    for v in (100.0, 101.0, 99.0)])
+    n_before = len(perf_gate.read_history(history))
+    rc = perf_gate.main(["--history", history, "--check",
+                         "--keys", "two_worker_fleet_ms",
+                         "--record-value", "two_worker_fleet_ms=100.0",
+                         "--seed-regression", "two_worker_fleet_ms:20"])
+    assert rc == 1                                     # 120ms vs 100 +/- 10
+    # The perturbed value must NOT have been appended.
+    assert len(perf_gate.read_history(history)) == n_before
+    # Seeding a higher-is-better key perturbs DOWN.
+    _seed(history, [{"serving_tok_s": v} for v in (800.0, 805.0, 795.0)])
+    rc = perf_gate.main(["--history", history, "--check",
+                         "--keys", "serving_tok_s",
+                         "--record-value", "serving_tok_s=800.0",
+                         "--seed-regression", "serving_tok_s:20"])
+    assert rc == 1
+
+
+def test_mad_band_tolerates_noisy_metric(history):
+    # Noisy history (MAD ~ 10): a +25 excursion sits inside 3*1.4826*MAD
+    # even though it exceeds the 10% floor.
+    _seed(history, [{"jitter_ms": v}
+                    for v in (100.0, 120.0, 90.0, 110.0, 80.0)])
+    rc = perf_gate.main(["--history", history, "--check",
+                         "--keys", "jitter_ms",
+                         "--record-value", "jitter_ms=125.0"])
+    assert rc == 0
+
+
+def test_record_unwraps_bench_extra_envelope(history, tmp_path):
+    """bench.py writes {"extra": [...], "headline": {...}}, not a bare
+    list — --record must flatten both."""
+    bench = tmp_path / "bench_extra.json"
+    bench.write_text(json.dumps(
+        {"extra": [{"metric": "runtime_protocol_ms_per_step",
+                    "value": 14.3, "two_worker_fleet_ms": 5.1},
+                   {"metric": "serving_tok_s", "value": 1300.0}],
+         "headline": {"metric": "tok_s_per_chip_tok_s", "value": 32000.0},
+         "headline_error": None}))
+    assert perf_gate.main(["--history", history, "--record",
+                           str(bench)]) == 0
+    vals = perf_gate.read_history(history)[-1]["values"]
+    assert vals["two_worker_fleet_ms"] == 5.1
+    assert vals["serving_tok_s"] == 1300.0
+    assert vals["tok_s_per_chip_tok_s"] == 32000.0
+
+
+def test_record_appends_and_check_uses_last_entry(history, tmp_path):
+    bench = tmp_path / "bench_extra.json"
+    bench.write_text(json.dumps(
+        [{"metric": "plan_verify_ms", "value": 3.0}]))
+    for _ in range(4):
+        assert perf_gate.main(["--history", history, "--record",
+                               str(bench)]) == 0
+    # --check with no new values gates the newest entry vs the rest.
+    assert perf_gate.main(["--history", history, "--check",
+                           "--keys", "plan_verify_ms"]) == 0
+    entries = perf_gate.read_history(history)
+    assert len(entries) == 4
+    assert all(e["values"]["plan_verify_ms"] == 3.0 for e in entries)
+
+
+def test_read_history_skips_torn_lines(history):
+    _seed(history, [{"a_ms": 1.0}])
+    with open(history, "a") as f:
+        f.write('{"ts": 1, "values": {"a_ms": 2.0')   # torn append
+    entries = perf_gate.read_history(history)
+    assert len(entries) == 1

@@ -1,0 +1,291 @@
+"""Perf regression gate over a rolling bench history.
+
+``bench.py`` (and tools/bench_runtime.py, tools/serve_load.py) emit
+point-in-time numbers; this tool makes them a TREND. Each ``--record``
+appends one line to ``bench_history.jsonl``::
+
+    {"ts": ..., "values": {"two_worker_fleet_ms": 103.2, ...}, "meta": ...}
+
+flattened from bench_extra.json records: every record's ``value`` lands
+under its ``metric`` name, and nested numeric measurement fields
+(``*_ms``/``*_us``/``*_x``/``*_pct``/``*tok_s``, e.g. the
+``two_worker_fleet_ms`` inside ``runtime_protocol_ms_per_step``) are
+promoted under their own names.
+
+``--check`` compares the current run against a rolling baseline per key:
+the MEDIAN of the last k (default 5, minimum 3) prior recordings, with a
+noise band of ``max(3 * 1.4826 * MAD, band_pct * median)`` — the MAD term
+tracks each metric's own run-to-run jitter, the ``band_pct`` floor stops
+a freakishly quiet history from flagging sub-noise wobble. Direction is
+inferred from the key name (``tok_s``/``_x``/``_per_s``/``_rate`` higher
+is better; everything else, e.g. ``_ms``, lower is better). A key with
+insufficient history is reported but never fails the gate.
+
+``--seed-regression KEY:PCT`` perturbs the current value by PCT in the
+bad direction before checking — scripts/ledger_smoke.sh uses it to prove
+the gate actually trips.
+
+Run:
+    python tools/perf_gate.py --record bench_extra.json
+    python tools/perf_gate.py --record bench_extra.json --check
+    python tools/perf_gate.py --check --seed-regression two_worker_fleet_ms:20
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+DEFAULT_HISTORY = os.path.join(HERE, "bench_history.jsonl")
+
+# The headline lines the gate watches by default (ISSUE pr9). --keys
+# widens or narrows the watchlist; recording always keeps everything.
+DEFAULT_KEYS = ("two_worker_fleet_ms", "serving_tok_s",
+                "paged_capacity_x", "plan_verify_ms")
+
+_HIGHER_BETTER_SUFFIXES = ("tok_s", "_x", "_per_s", "_rate", "_speedup")
+_PROMOTE_SUFFIXES = ("_ms", "_us", "_x", "_pct", "tok_s", "_per_s",
+                     "_rate")
+
+
+def higher_is_better(key: str) -> bool:
+    return key.endswith(_HIGHER_BETTER_SUFFIXES)
+
+
+def _numeric(v: Any) -> Optional[float]:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def flatten_records(records: List[Dict[str, Any]]) -> Dict[str, float]:
+    """bench_extra.json lines -> flat {key: value}. ``value`` lands under
+    the record's ``metric``; nested numeric measurement fields are
+    promoted under their own (unprefixed) names."""
+    out: Dict[str, float] = {}
+    for rec in records:
+        metric = rec.get("metric")
+        v = _numeric(rec.get("value"))
+        if metric and v is not None:
+            out[metric] = v
+        for k, nested in rec.items():
+            if k in ("value", "metric"):
+                continue
+            nv = _numeric(nested)
+            if nv is not None and k.endswith(_PROMOTE_SUFFIXES):
+                out[k] = nv
+    return out
+
+
+def serve_json_values(summary: Dict[str, Any]) -> Dict[str, float]:
+    """tools/serve_load.py --out summary -> gate keys."""
+    out: Dict[str, float] = {}
+    tok = _numeric(summary.get("tokens_per_s"))
+    if tok is not None:
+        out["serving_tok_s"] = tok
+    ttft = summary.get("ttft_ms") or {}
+    for pct in ("p50", "p95"):
+        v = _numeric(ttft.get(pct))
+        if v is not None:
+            out[f"serving_ttft_ms_{pct}"] = v
+    return out
+
+
+def read_history(path: str) -> List[Dict[str, Any]]:
+    entries: List[Dict[str, Any]] = []
+    if not os.path.exists(path):
+        return entries
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entries.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue          # a torn append must not wedge the gate
+    return entries
+
+
+def append_history(path: str, values: Dict[str, float],
+                   meta: Optional[Dict[str, Any]] = None) -> None:
+    entry = {"ts": round(time.time(), 3), "values": values}
+    if meta:
+        entry["meta"] = meta
+    with open(path, "a") as f:
+        f.write(json.dumps(entry, separators=(",", ":")) + "\n")
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def baseline(history: List[Dict[str, Any]], key: str, k: int = 5,
+             min_n: int = 3) -> Optional[Dict[str, float]]:
+    """Rolling median-of-k baseline + MAD for one key over the most
+    recent prior entries carrying it. None when history is too thin."""
+    xs = [e["values"][key] for e in history
+          if _numeric((e.get("values") or {}).get(key)) is not None]
+    xs = xs[-k:]
+    if len(xs) < min_n:
+        return None
+    med = _median(xs)
+    mad = _median([abs(x - med) for x in xs])
+    return {"median": med, "mad": mad, "n": len(xs)}
+
+
+def check_values(values: Dict[str, float],
+                 history: List[Dict[str, Any]],
+                 keys: Tuple[str, ...] = DEFAULT_KEYS,
+                 k: int = 5, band_pct: float = 0.10
+                 ) -> List[Dict[str, Any]]:
+    """Per-key verdicts: ok / regression / improved / no-baseline /
+    missing. Only 'regression' fails the gate."""
+    rows: List[Dict[str, Any]] = []
+    for key in keys:
+        cur = values.get(key)
+        row: Dict[str, Any] = {"key": key, "current": cur,
+                               "higher_better": higher_is_better(key)}
+        if cur is None:
+            row["verdict"] = "missing"
+            rows.append(row)
+            continue
+        base = baseline(history, key, k=k)
+        if base is None:
+            row["verdict"] = "no-baseline"
+            rows.append(row)
+            continue
+        med, mad = base["median"], base["mad"]
+        band = max(3.0 * 1.4826 * mad, band_pct * abs(med))
+        row.update(baseline_median=round(med, 3), band=round(band, 3),
+                   n_baseline=base["n"])
+        if higher_is_better(key):
+            if cur < med - band:
+                row["verdict"] = "regression"
+            elif cur > med + band:
+                row["verdict"] = "improved"
+            else:
+                row["verdict"] = "ok"
+        else:
+            if cur > med + band:
+                row["verdict"] = "regression"
+            elif cur < med - band:
+                row["verdict"] = "improved"
+            else:
+                row["verdict"] = "ok"
+        rows.append(row)
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("perf_gate")
+    ap.add_argument("--history", default=DEFAULT_HISTORY,
+                    help="bench_history.jsonl path")
+    ap.add_argument("--record", default=None, metavar="BENCH_EXTRA",
+                    help="flatten a bench_extra.json and append to history")
+    ap.add_argument("--serve-json", default=None, metavar="SUMMARY",
+                    help="also fold a serve_load.py --json summary in")
+    ap.add_argument("--record-value", action="append", default=[],
+                    metavar="KEY=VAL",
+                    help="record an explicit value (repeatable)")
+    ap.add_argument("--check", action="store_true",
+                    help="compare current values against the rolling "
+                         "baseline; exit 1 on any regression")
+    ap.add_argument("--keys", default=",".join(DEFAULT_KEYS),
+                    help="comma-separated keys --check gates on")
+    ap.add_argument("--k", type=int, default=5,
+                    help="baseline window (median of last k, min 3)")
+    ap.add_argument("--band-pct", type=float, default=0.10,
+                    help="relative noise-band floor")
+    ap.add_argument("--seed-regression", default=None, metavar="KEY:PCT",
+                    help="perturb KEY by PCT in the bad direction before "
+                         "checking (gate self-test)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    values: Dict[str, float] = {}
+    if args.record:
+        with open(args.record) as f:
+            records = json.load(f)
+        if isinstance(records, dict):
+            # bench.py's envelope: {"extra": [lines], "headline": line}.
+            headline = records.get("headline")
+            records = list(records.get("extra") or []) + \
+                ([headline] if isinstance(headline, dict) else [])
+        if not isinstance(records, list):
+            records = [records]
+        values.update(flatten_records(records))
+    if args.serve_json:
+        with open(args.serve_json) as f:
+            values.update(serve_json_values(json.load(f)))
+    for kv in args.record_value:
+        key, _, val = kv.partition("=")
+        values[key.strip()] = float(val)
+
+    history = read_history(args.history)
+
+    if values and not args.seed_regression:
+        # A seeded (perturbed) run must never pollute the real history.
+        append_history(args.history, values,
+                       meta={"source": args.record or args.serve_json
+                             or "cli"})
+
+    if not args.check:
+        if args.json:
+            print(json.dumps({"recorded": values,
+                              "history_len": len(history) + bool(values)}))
+        else:
+            print(f"recorded {len(values)} value(s) -> {args.history} "
+                  f"(history: {len(history) + bool(values)} entries)")
+        return 0
+
+    # --check: current = this invocation's values, else the newest entry.
+    prior = history
+    if not values:
+        if not history:
+            print("perf gate: no history and no values to check",
+                  file=sys.stderr)
+            return 2
+        values = dict(history[-1].get("values") or {})
+        prior = history[:-1]
+
+    if args.seed_regression:
+        key, _, pct = args.seed_regression.partition(":")
+        pct = float(pct or 20.0)
+        if key in values:
+            sign = -1.0 if higher_is_better(key) else 1.0
+            values[key] *= (1.0 + sign * pct / 100.0)
+
+    keys = tuple(k for k in args.keys.split(",") if k)
+    rows = check_values(values, prior, keys=keys, k=args.k,
+                        band_pct=args.band_pct)
+    bad = [r for r in rows if r["verdict"] == "regression"]
+    if args.json:
+        print(json.dumps({"rows": rows, "ok": not bad}, indent=1))
+    else:
+        for r in rows:
+            cur = "-" if r["current"] is None else f"{r['current']:.3f}"
+            base = (f"median {r['baseline_median']} +/- {r['band']} "
+                    f"(n={r['n_baseline']})"
+                    if "baseline_median" in r else "no baseline")
+            arrow = "^" if r["higher_better"] else "v"
+            print(f"  {r['key']:<28} {cur:>12} vs {base:<34} "
+                  f"[{arrow}] {r['verdict']}")
+        print("perf gate: " + ("FAILED on " +
+                               ", ".join(r["key"] for r in bad)
+                               if bad else "OK"))
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -222,6 +222,9 @@ def main(argv=None) -> Dict[str, Any]:
     ap.add_argument("--trace", default=None,
                     help="dump the merged trace JSON here")
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the summary JSON here (the file "
+                         "tools/perf_gate.py --serve-json consumes)")
     args = ap.parse_args(argv)
     if args.shared_prefix + 2 > args.max_len:
         ap.error(f"--shared-prefix {args.shared_prefix} leaves no room "
@@ -238,6 +241,9 @@ def main(argv=None) -> Dict[str, Any]:
         prefill_chunk=args.prefill_chunk,
         shared_prefix=args.shared_prefix,
         long_prompt=args.long_prompt)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=1)
     if args.json:
         print(json.dumps(summary, indent=1))
     else:

@@ -110,6 +110,80 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
     fid = _fidelity_section(trace)
     if fid is not None:
         out["fidelity"] = fid
+    led = _ledger_section(trace)
+    if led is not None:
+        out["ledger"] = led
+    fl = _flight_section(trace)
+    if fl is not None:
+        out["flight"] = fl
+    return out
+
+
+def _ledger_section(trace: Dict[str, Any]) -> Any:
+    """Per-verb wire/serde totals + the step gap table when the trace
+    embeds a merged RPC ledger (metadata.ledger, TEPDIST_LEDGER=1)."""
+    snap = (trace.get("metadata") or {}).get("ledger")
+    if not snap:
+        return None
+    try:
+        from tepdist_tpu.telemetry import ledger
+    except ImportError:
+        return {"error": "tepdist_tpu not importable"}
+    verbs = {}
+    for v, s in (snap.get("verbs") or {}).items():
+        verbs[v] = {
+            "calls": int(s.get("calls", 0)),
+            "retries": int(s.get("retries", 0)),
+            "tx_bytes": int(s.get("tx_header_bytes", 0)
+                            + s.get("tx_blob_bytes", 0)),
+            "rx_bytes": int(s.get("rx_header_bytes", 0)
+                            + s.get("rx_blob_bytes", 0)),
+            "encode_ms": round(s.get("encode_us", 0) / 1e3, 3),
+            "decode_ms": round(s.get("decode_us", 0) / 1e3, 3),
+            "client_ms": round(s.get("client_us", 0) / 1e3, 3),
+            "server_ms": round(s.get("server_us", 0) / 1e3, 3),
+        }
+    return {"verbs": verbs,
+            "gap_table": ledger.gap_table(snap),
+            "intervals_dropped": snap.get("intervals_dropped")}
+
+
+def _flight_section(trace: Dict[str, Any]) -> Any:
+    """Per-request digest of the serving flight recorder
+    (metadata.flight): event counts, terminal state, engine
+    generations touched, and queue->deliver latency."""
+    events = (trace.get("metadata") or {}).get("flight")
+    if not events:
+        return None
+    TERMINAL = ("deliver", "finish", "fail", "cancel", "expire",
+                "reject", "overload")
+    reqs = {}
+    for e in events:
+        rid = e.get("rid", "?")
+        r = reqs.setdefault(rid, {"events": 0, "first_ts": None,
+                                  "last_ts": None, "gens": set(),
+                                  "terminal": None, "by_ev": {}})
+        r["events"] += 1
+        ts = e.get("ts", 0)
+        if r["first_ts"] is None:
+            r["first_ts"] = ts
+        r["last_ts"] = ts
+        ev = e.get("ev", "?")
+        r["by_ev"][ev] = r["by_ev"].get(ev, 0) + 1
+        gen = (e.get("args") or {}).get("gen")
+        if gen is not None:
+            r["gens"].add(gen)
+        if ev in TERMINAL:
+            r["terminal"] = ev
+    out = {}
+    for rid, r in sorted(reqs.items()):
+        out[rid] = {
+            "events": r["events"],
+            "gens": sorted(r["gens"]),
+            "terminal": r["terminal"],
+            "span_ms": round((r["last_ts"] - r["first_ts"]) / 1e3, 3),
+            "by_ev": r["by_ev"],
+        }
     return out
 
 
@@ -249,6 +323,38 @@ def main() -> None:
                   f"transfer={a['transfer_ms']} "
                   f"serde={a['host_serde_ms']} idle={a['idle_ms']} "
                   f"(window {a['window_ms']} ms)")
+    led = s.get("ledger")
+    if led and not led.get("error"):
+        print("rpc ledger (per verb):")
+        print(f"  {'verb':<24} {'calls':>6} {'tx_bytes':>10} "
+              f"{'rx_bytes':>10} {'enc_ms':>8} {'dec_ms':>8} "
+              f"{'cli_ms':>9} {'srv_ms':>9}")
+        for v, r in sorted(led["verbs"].items(),
+                           key=lambda kv: -kv[1]["client_ms"]):
+            print(f"  {v:<24} {r['calls']:>6} {r['tx_bytes']:>10} "
+                  f"{r['rx_bytes']:>10} {r['encode_ms']:>8.3f} "
+                  f"{r['decode_ms']:>8.3f} {r['client_ms']:>9.3f} "
+                  f"{r['server_ms']:>9.3f}")
+        agg = (led.get("gap_table") or {}).get("aggregate")
+        if agg:
+            b = agg["buckets"]
+            print(f"  step gap table (mean over {agg['n_steps']} steady "
+                  f"steps, wall {agg['wall_ms']} ms, coverage "
+                  f"{agg['coverage']:.1%}):")
+            print(f"    serde={b['serde_ms']} "
+                  f"rpc_orchestration={b['rpc_orchestration_ms']} "
+                  f"compute={b['compute_ms']} "
+                  f"dependency_idle={b['dependency_idle_ms']} "
+                  f"unattributed={b['unattributed_ms']} ms")
+    fl = s.get("flight")
+    if fl:
+        print("flight recorder (per request; full waterfall: "
+              "tools/request_trace.py):")
+        for rid, r in fl.items():
+            gens = f" gens={r['gens']}" if r["gens"] else ""
+            print(f"  {rid:<12} {r['events']:>3} events "
+                  f"span={r['span_ms']:.1f} ms "
+                  f"terminal={r['terminal']}{gens}")
     analysis = {k: v for k, v in counters.items()
                 if k in ("plan_verified", "lockdep_runtime_edges")}
     if analysis:
