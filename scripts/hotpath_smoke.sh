@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Hot-path smoke: prove the ISSUE-11 RPC hot path end to end on a fresh
+# two-worker in-proc fleet fixture.
+#
+#   1. LEDGER EXACTNESS: tools/ledger_report.py runs the fixture with
+#      batched dispatch + send overlap at their defaults (ON); --check
+#      fails unless the gap-table buckets sum to each step's wall
+#      exactly, coverage holds, and the serde bucket reconciles with the
+#      independent fidelity attribution — i.e. the coalesced
+#      ExecuteStepSlice framing path stays byte-accounted.
+#   2. PERF GATE, NEW KEYS: the report's rpc_orchestration_ms and
+#      serde_ms buckets (plus the fleet step wall) are recorded three
+#      times to build a rolling baseline, then --check must pass on the
+#      real values and MUST fail on a seeded 25% rpc_orchestration_ms
+#      slowdown (the gate actually trips on the new keys).
+#
+# Override the per-pass bound with HOTPATH_SMOKE_TIMEOUT (seconds).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${HOTPATH_SMOKE_TIMEOUT:-600}"
+TMPDIR_SMOKE="$(mktemp -d)"
+trap 'rm -rf "$TMPDIR_SMOKE"' EXIT
+
+echo "=== hotpath smoke 1/2: ledger byte-exactness under batched dispatch ==="
+# Same coverage floor rationale as ledger_smoke.sh: loaded 1-core CI
+# hosts land 93-95% occasionally; the bucket-sum identity stays exact.
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python tools/ledger_report.py \
+    --steps 6 --check --min-coverage 0.93 \
+    --json > "$TMPDIR_SMOKE/ledger_report.json"
+
+echo "=== hotpath smoke 2/2: perf gate on rpc_orchestration_ms + serde_ms ==="
+HIST="$TMPDIR_SMOKE/bench_history.jsonl"
+read -r FLEET_MS RPC_MS SERDE_MS <<<"$(python - "$TMPDIR_SMOKE/ledger_report.json" <<'PY'
+import json, sys
+r = json.load(open(sys.argv[1]))
+b = r["gap_table"]["aggregate"]["buckets"]
+print(r["fleet_step_ms"], b["rpc_orchestration_ms"], b["serde_ms"])
+PY
+)"
+echo "fleet_step_ms=$FLEET_MS rpc_orchestration_ms=$RPC_MS serde_ms=$SERDE_MS"
+for i in 1 2 3; do
+    timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST" \
+        --record-value "two_worker_fleet_ms=$FLEET_MS" \
+        --record-value "rpc_orchestration_ms=$RPC_MS" \
+        --record-value "serde_ms=$SERDE_MS" > /dev/null
+done
+timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST" \
+    --check --keys two_worker_fleet_ms,rpc_orchestration_ms,serde_ms \
+    --record-value "two_worker_fleet_ms=$FLEET_MS" \
+    --record-value "rpc_orchestration_ms=$RPC_MS" \
+    --record-value "serde_ms=$SERDE_MS"
+if timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST" \
+    --check --keys rpc_orchestration_ms \
+    --record-value "rpc_orchestration_ms=$RPC_MS" \
+    --seed-regression rpc_orchestration_ms:25; then
+    echo "hotpath smoke: FAIL (seeded 25% rpc regression did not trip)"
+    exit 1
+fi
+
+echo "hotpath smoke: PASS"

@@ -89,6 +89,28 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
      "measured validation calibrates it to the Python dispatch floor"),
     ("REMAT_POLICY", str, "none", "[tpu] jax.checkpoint policy for stages"),
     ("DONATE_ARGS", bool, True, "[tpu] donate variable buffers into the step"),
+    # --- RPC hot path -----------------------------------------------------
+    ("TEPDIST_BATCH_DISPATCH", bool, True, "coalesce the master's per-step "
+     "fleet dispatch into ONE ExecuteStepSlice RPC per worker (micro-batch "
+     "slices + the execute trigger ride a single envelope, results return "
+     "in one reply); 0 = legacy per-verb path (TransferHostRawData pushes "
+     "+ ExecuteRemotePlan)"),
+    ("TEPDIST_SEND_OVERLAP", bool, True, "workers overlap host-push "
+     "activation serde + the peer RPC with the tail of compute (async "
+     "send pool, joined at step end); 0 = synchronous sends inside the "
+     "task loop"),
+    ("TEPDIST_WIRE_DTYPE", str, "", "opt-in wire dtype for host-push "
+     "activation payloads (e.g. 'bfloat16'): f32/f64 tensors are "
+     "down-cast on the wire and restored to their source dtype on "
+     "arrival — halves tx_blob bytes at reduced mantissa (EQuARX-style "
+     "lossy wire compression, arXiv:2506.17615); default '' keeps the "
+     "wire bit-identical"),
+    ("TEPDIST_HEAVY_RPC_SLOTS", int, 0, "bounded async server executor: "
+     "max concurrently RUNNING heavy handlers (ExecuteStepSlice/"
+     "ExecuteRemotePlan/ExecutePlan/BuildExecutionPlan/LoadServable) per "
+     "gRPC server, so control verbs (Ping/AbortStep/telemetry/serving "
+     "polls) never queue behind long executes; 0 = auto "
+     "(max(2, max_workers // 4)), negative = unbounded"),
     # --- telemetry --------------------------------------------------------
     ("TEPDIST_TRACE", bool, False, "record step/planner spans for the "
      "merged Perfetto timeline (telemetry/); DEBUG implies it"),

@@ -98,6 +98,11 @@ class GRPCStub:
         if action == "drop_request":
             raise faults.InjectedFault(
                 f"{method} request dropped", kind="rpc_drop")
+        if isinstance(payload, protocol.Frames):
+            # The channel boundary is the ONE place scatter-gather frames
+            # materialize for gRPC; Frames caches the join, so retries
+            # replay identical bytes without re-joining.
+            payload = payload.join()
         resp = self._methods[method](payload, timeout=timeout)
         if action == "drop_response":
             raise faults.InjectedFault(
@@ -143,9 +148,11 @@ class TepdistClient:
             header["idem"] = f"{self._uid}:{method}:{next(self._idem_seq)}"
         # Ledger step attribution: the header's step= tag covers the pack
         # (and, in-proc, the whole server handler on this same thread).
+        # pack_frames borrows the blob buffers: inproc hands the segments
+        # straight to the handler, gRPC joins once at the channel.
         with wire_ledger.step_hint(header.get("step")):
             return self.stub.call(method,
-                                  protocol.pack(header, list(blobs)),
+                                  protocol.pack_frames(header, list(blobs)),
                                   timeout=timeout,
                                   max_attempts=max_attempts)
 

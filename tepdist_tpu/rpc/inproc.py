@@ -93,9 +93,12 @@ class InProcStub:
                 raise ConnectionError(
                     f"worker {ti} crashed (injected worker_crash)")
             if plan.has_crash_rule(ti) and method in ("ExecutePlan",
-                                                      "ExecuteRemotePlan"):
+                                                      "ExecuteRemotePlan",
+                                                      "ExecuteStepSlice"):
                 try:
-                    step = protocol.unpack(payload)[0].get("step")
+                    # peek_header: ledger-free — the handler's own unpack
+                    # is the one byte-accounted parse of this request.
+                    step = protocol.peek_header(payload).get("step")
                 except Exception:  # noqa: BLE001 — malformed = no step
                     step = None
                 if plan.crash_on_step(ti, step):

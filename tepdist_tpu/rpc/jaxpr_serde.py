@@ -685,7 +685,8 @@ def serialize_closed_jaxpr(closed, inline: bool = True) -> bytes:
 
 
 def deserialize_closed_jaxpr(data: bytes):
-    return _decode_closed(json.loads(data.decode()))
+    # ``data`` may be a zero-copy memoryview blob (rpc/protocol.unpack).
+    return _decode_closed(json.loads(bytes(data).decode()))
 
 
 def serialize_pytree_leaves(tree) -> Tuple[bytes, Any]:
@@ -698,4 +699,4 @@ def serialize_pytree_leaves(tree) -> Tuple[bytes, Any]:
 
 
 def deserialize_leaves(data: bytes) -> List[np.ndarray]:
-    return [decode_value(d) for d in json.loads(data.decode())]
+    return [decode_value(d) for d in json.loads(bytes(data).decode())]

@@ -77,6 +77,7 @@ METHODS = [
     "TransferModuleAndDefCtx",
     "DispatchPlan",
     "ExecuteRemotePlan",
+    "ExecuteStepSlice",
     "InitMeshTopology",
     "DoRemoteSave",
     "DoRemoteRestore",
@@ -99,6 +100,68 @@ GRPC_OPTIONS = [
 _MAGIC = b"TPD1"
 
 
+def _nbytes(b) -> int:
+    return b.nbytes if isinstance(b, memoryview) else len(b)
+
+
+class Frames:
+    """Scatter-gather envelope: the segment list of one packed frame
+    (one framing/header segment + per-blob length prefixes + BORROWED
+    blob buffers), deferring the ``b"".join`` to the transport boundary.
+    ``len(frames)`` is the joined frame length; ``join()`` materializes
+    (and caches) the contiguous frame for transports that need one
+    buffer (gRPC); inproc hands the Frames object straight to the
+    handler and never joins."""
+
+    __slots__ = ("segments", "header_bytes", "blob_bytes", "nbytes",
+                 "_joined")
+
+    def __init__(self, segments, header_bytes: int, blob_bytes: int):
+        self.segments = segments
+        self.header_bytes = header_bytes
+        self.blob_bytes = blob_bytes
+        self.nbytes = header_bytes + blob_bytes
+        self._joined = None
+
+    def __len__(self) -> int:
+        return self.nbytes
+
+    def join(self) -> bytes:
+        # Cached so a transport retry replays byte-identical payload
+        # without re-joining (and without racing a caller that mutated
+        # a borrowed buffer after the first send).
+        if self._joined is None:
+            self._joined = b"".join(self.segments)
+        return self._joined
+
+    def __bytes__(self) -> bytes:
+        return self.join()
+
+
+def _build_segments(header: Dict[str, Any], blobs) -> Tuple[list, int, int]:
+    """One preallocated head segment (MAGIC | u32 header_len |
+    header_json | u32 n_blobs) + per blob an 8-byte length prefix and a
+    borrowed view of the payload. Returns (segments, header_bytes,
+    blob_bytes) with header_bytes + blob_bytes == joined length exactly
+    (the ledger invariant)."""
+    h = json.dumps(header, separators=(",", ":")).encode()
+    head = bytearray(12 + len(h))
+    head[0:4] = _MAGIC
+    struct.pack_into("<I", head, 4, len(h))
+    head[8:8 + len(h)] = h
+    struct.pack_into("<I", head, 8 + len(h), len(blobs))
+    segments: list = [head]
+    blob_bytes = 0
+    for b in blobs:
+        if isinstance(b, memoryview) and not b.c_contiguous:
+            b = bytes(b)      # join/transports need contiguous buffers
+        n = _nbytes(b)
+        segments.append(struct.pack("<Q", n))
+        segments.append(b)
+        blob_bytes += n
+    return segments, 12 + len(h) + 8 * len(blobs), blob_bytes
+
+
 def pack(header: Dict[str, Any], blobs: List[bytes] = ()) -> bytes:
     """Envelope: MAGIC | u32 header_len | header_json | u32 n_blobs |
     (u64 len | bytes)*
@@ -115,51 +178,105 @@ def pack(header: Dict[str, Any], blobs: List[bytes] = ()) -> bytes:
     # otherwise dominate the comparison).
     with span("serde:pack", cat="serde") as sp:
         t0 = time.time_ns() // 1000 if led is not None else 0
-        h = json.dumps(header, separators=(",", ":")).encode()
-        parts = [_MAGIC, struct.pack("<I", len(h)), h,
-                 struct.pack("<I", len(blobs))]
-        for b in blobs:
-            parts.append(struct.pack("<Q", len(b)))
-            parts.append(bytes(b))
-        frame = b"".join(parts)
+        segments, hb, bb = _build_segments(header, blobs)
+        frame = b"".join(segments)
         sp.set(bytes=len(frame))
         t1 = time.time_ns() // 1000 if led is not None else 0
     if led is not None:
-        blob_total = sum(len(b) for b in blobs)
-        led.record_pack(len(frame) - blob_total, blob_total, t0, t1)
+        led.record_pack(hb, bb, t0, t1)
     return frame
 
 
-def unpack(data: bytes) -> Tuple[Dict[str, Any], List[bytes]]:
+def pack_frames(header: Dict[str, Any], blobs: List[bytes] = ()) -> Frames:
+    """``pack`` without the join: returns a :class:`Frames` whose
+    segments borrow the blob buffers (zero copy). Ledger accounting is
+    identical to ``pack`` — the deferred join changes when bytes are
+    materialized, never how many are accounted."""
     led = wire_ledger.active()
-    total = len(data)
-    if total < 12 or data[:4] != _MAGIC:
+    with span("serde:pack", cat="serde") as sp:
+        t0 = time.time_ns() // 1000 if led is not None else 0
+        segments, hb, bb = _build_segments(header, blobs)
+        frames = Frames(segments, hb, bb)
+        sp.set(bytes=frames.nbytes)
+        t1 = time.time_ns() // 1000 if led is not None else 0
+    if led is not None:
+        led.record_pack(hb, bb, t0, t1)
+    return frames
+
+
+def _unpack_frames(frames: Frames):
+    """Zero-copy fast path: header parsed from the head segment, blob
+    segments returned as-is (borrowed). Accounting matches a joined-frame
+    parse to the byte."""
+    led = wire_ledger.active()
+    with span("serde:unpack", cat="serde") as sp:
+        t0 = time.time_ns() // 1000 if led is not None else 0
+        head = frames.segments[0]
+        if len(head) < 12 or bytes(head[0:4]) != _MAGIC:
+            raise ValueError("bad envelope magic")
+        (hlen,) = struct.unpack_from("<I", head, 4)
+        header = json.loads(bytes(head[8:8 + hlen]).decode())
+        blobs = frames.segments[2::2]
+        sp.set(bytes=frames.nbytes)
+        t1 = time.time_ns() // 1000 if led is not None else 0
+    if led is not None:
+        led.record_unpack(frames.header_bytes, frames.blob_bytes, t0, t1)
+    return header, blobs
+
+
+def peek_header(data) -> Dict[str, Any]:
+    """Parse ONLY the JSON header, touching neither the ledger nor the
+    trace: transport-layer introspection (fault-plan step matching in
+    rpc/inproc.py) must not double-count a request the handler will
+    unpack again."""
+    if isinstance(data, Frames):
+        head = data.segments[0]
+    else:
+        head = memoryview(data)
+    if len(head) < 12 or bytes(head[0:4]) != _MAGIC:
+        raise ValueError("bad envelope magic")
+    (hlen,) = struct.unpack_from("<I", head, 4)
+    if 8 + hlen > len(head):
+        raise ValueError("truncated envelope (header)")
+    return json.loads(bytes(head[8:8 + hlen]).decode())
+
+
+def unpack(data) -> Tuple[Dict[str, Any], List[bytes]]:
+    """Accepts bytes/bytearray/memoryview or a :class:`Frames` (inproc
+    fast path, no join). Blob payloads are returned as zero-copy
+    memoryviews into ``data``."""
+    if isinstance(data, Frames):
+        return _unpack_frames(data)
+    led = wire_ledger.active()
+    mv = data if isinstance(data, memoryview) else memoryview(data)
+    total = mv.nbytes
+    if total < 12 or bytes(mv[:4]) != _MAGIC:
         raise ValueError("bad envelope magic")
     with span("serde:unpack", cat="serde") as sp:
         t0 = time.time_ns() // 1000 if led is not None else 0
         off = 4
-        (hlen,) = struct.unpack_from("<I", data, off)
+        (hlen,) = struct.unpack_from("<I", mv, off)
         off += 4
         if off + hlen + 4 > total:
             raise ValueError("truncated envelope (header)")
-        header = json.loads(data[off:off + hlen].decode())
+        header = json.loads(bytes(mv[off:off + hlen]).decode())
         off += hlen
-        (n,) = struct.unpack_from("<I", data, off)
+        (n,) = struct.unpack_from("<I", mv, off)
         off += 4
         blobs = []
         for i in range(n):
             if off + 8 > total:
                 raise ValueError(f"truncated envelope (blob {i} length)")
-            (blen,) = struct.unpack_from("<Q", data, off)
+            (blen,) = struct.unpack_from("<Q", mv, off)
             off += 8
             if off + blen > total:
                 raise ValueError(f"truncated envelope (blob {i} payload)")
-            blobs.append(data[off:off + blen])
+            blobs.append(mv[off:off + blen])
             off += blen
         sp.set(bytes=total)
         t1 = time.time_ns() // 1000 if led is not None else 0
     if led is not None:
-        blob_total = sum(len(b) for b in blobs)
+        blob_total = sum(b.nbytes for b in blobs)
         led.record_unpack(total - blob_total, blob_total, t0, t1)
     return header, blobs
 
@@ -170,31 +287,61 @@ def unpack(data: bytes) -> Tuple[Dict[str, Any], List[bytes]]:
 # (telemetry/fidelity.py) — the round-5 probe's ~31 ms/step Python serde
 # verdict, measured permanently. Disabled tracing costs one branch.
 
-def encode_literal(x) -> Tuple[Dict[str, Any], bytes]:
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _blob_view(arr: np.ndarray) -> memoryview:
+    """Borrowed byte view of a C-contiguous array (any dtype, incl.
+    bf16): flatten (a view) then reinterpret as uint8 — never copies."""
+    return memoryview(arr.reshape(-1).view(np.uint8))
+
+
+def encode_literal(x, wire_dtype: str = None) -> Tuple[Dict[str, Any], bytes]:
+    """Array -> (meta, blob). The blob BORROWS the array's buffer when
+    it is C-contiguous (zero copy); only non-contiguous inputs — or an
+    opt-in ``wire_dtype`` down-cast (TEPDIST_WIRE_DTYPE) — materialize,
+    so a tensor crosses the wire with at most one copy. The ledger's
+    ``copies`` counter records every materialization."""
     led = wire_ledger.active()
     with span("serde:encode", cat="serde") as sp:
         t0 = time.time_ns() // 1000 if led is not None else 0
         arr = np.asarray(x)
-        blob = np.ascontiguousarray(arr).tobytes()
-        sp.set(bytes=len(blob))
+        meta = {"dtype": arr.dtype.name, "shape": list(arr.shape)}
+        copies = 0
+        if wire_dtype and arr.dtype in (np.dtype(np.float32),
+                                        np.dtype(np.float64)):
+            wdt = _resolve_dtype(wire_dtype)
+            if wdt != arr.dtype:
+                meta["wire_from"] = arr.dtype.name
+                meta["dtype"] = wdt.name
+                arr = arr.astype(wdt)  # astype output is C-contiguous
+                copies = 1
+        if not arr.flags.c_contiguous:
+            arr = np.ascontiguousarray(arr)
+            copies = 1
+        blob = _blob_view(arr)
+        sp.set(bytes=blob.nbytes)
         t1 = time.time_ns() // 1000 if led is not None else 0
     if led is not None:
-        led.record_encode(t0, t1)
-    return ({"dtype": arr.dtype.name, "shape": list(arr.shape)}, blob)
+        led.record_encode(t0, t1, copies=copies)
+    return (meta, blob)
 
 
 def decode_literal(meta: Dict[str, Any], blob: bytes) -> np.ndarray:
     led = wire_ledger.active()
     with span("serde:decode", cat="serde") as sp:
         t0 = time.time_ns() // 1000 if led is not None else 0
-        name = meta["dtype"]
-        try:
-            dt = np.dtype(name)
-        except TypeError:
-            import ml_dtypes
-            dt = np.dtype(getattr(ml_dtypes, name))
-        sp.set(bytes=len(blob))
+        dt = _resolve_dtype(meta["dtype"])
+        sp.set(bytes=_nbytes(blob))
         out = np.frombuffer(blob, dtype=dt).reshape(meta["shape"])
+        wire_from = meta.get("wire_from")
+        if wire_from:
+            out = out.astype(_resolve_dtype(wire_from))
         t1 = time.time_ns() // 1000 if led is not None else 0
     if led is not None:
         led.record_decode(t0, t1)

@@ -57,6 +57,7 @@ DEADLINES = {
     "DoRemoteRestore": 300.0,
     "ExecutePlan": 600.0,
     "ExecuteRemotePlan": 600.0,
+    "ExecuteStepSlice": 600.0,
     "BuildExecutionPlan": 900.0,
     # Serving: LoadServable ships params + warms compiles; PollResult's
     # budget is on top of the client-requested long-poll wait.
@@ -74,7 +75,8 @@ DEFAULT_DEADLINE = 300.0
 # docstring). Transport errors on these verbs are still retried — the
 # server-side idempotency cache absorbs an applied-but-unacknowledged
 # replay.
-NO_DEADLINE_RETRY = {"ExecutePlan", "ExecuteRemotePlan", "Ping"}
+NO_DEADLINE_RETRY = {"ExecutePlan", "ExecuteRemotePlan",
+                     "ExecuteStepSlice", "Ping"}
 
 
 def deadline_for(method: str, override: Optional[float] = None) -> float:

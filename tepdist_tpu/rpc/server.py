@@ -1103,6 +1103,40 @@ class TepdistServicer:
             result = self.worker_plan.run_step(step)
         return protocol.pack({"ok": True, **result})
 
+    def ExecuteStepSlice(self, request: bytes, context=None) -> bytes:
+        """Coalesced per-step dispatch: this worker's whole micro-batch
+        slice set + the execute trigger in ONE envelope, results in one
+        reply (per-verb round trips dominated the fleet/single-process
+        gap — ROADMAP item 5; cf. coalesced MPMD dispatch,
+        arXiv:2412.14374). Semantics compose the two legacy verbs
+        unchanged: the raw-store puts are idempotent keyed writes with
+        the same stale-plan-generation drop as TransferHostRawData, and
+        the execute half rides the WorkerPlan's completed-step cache, so
+        a transport-retried or master-retried slice dedups exactly like
+        ExecuteRemotePlan."""
+        header, blobs = protocol.unpack(request)
+        # Injection BEFORE any effect (mirrors ExecuteRemotePlan): the
+        # completed-step cache makes a replay a cache hit, so a post-run
+        # fault would only exercise the rpc retry, never the master's
+        # _recover_step ladder.
+        self._inject_server_fault("ExecuteStepSlice")
+        gen = header.get("plan_gen")
+        if gen is not None and gen != self.plan_gen:
+            # Stale-plan dispatch (an evicted-but-alive master resuming a
+            # wedged step): acknowledge but neither store nor run.
+            return protocol.pack({"ok": False, "stale_plan_gen": gen})
+        for i, ent in enumerate(header.get("raw_multi", ())):
+            self.raw_store.put(
+                ent["raw_key"],
+                protocol.decode_literal(ent["literal"], blobs[i]))
+        if self.worker_plan is None:
+            return protocol.pack({"ok": True, "losses": []})
+        step = int(header.get("step", 0))
+        with span("ExecuteStepSlice", cat="rpc", step=step), \
+                wire_ledger.step_hint(step):
+            result = self.worker_plan.run_step(step)
+        return protocol.pack({"ok": True, **result})
+
     def InitMeshTopology(self, request: bytes, context=None) -> bytes:
         header, _ = protocol.unpack(request)
         self.cluster_spec = header.get("cluster_spec", {})
@@ -1422,23 +1456,65 @@ class TepdistServicer:
         self.servables.clear()
 
 
+# Verbs whose handlers can run for seconds-to-minutes (execute/compile/
+# model-load). The bounded executor gates THESE so the short control verbs
+# — heartbeat Pings, AbortStep fences, telemetry pulls, serving polls —
+# always find a free pool thread instead of queueing behind them.
+HEAVY_VERBS = frozenset({
+    "ExecuteStepSlice", "ExecuteRemotePlan", "ExecutePlan",
+    "BuildExecutionPlan", "LoadServable",
+})
+
+
+def heavy_rpc_slots(max_workers: int) -> Optional[int]:
+    """Resolve the heavy-handler concurrency bound from the
+    TEPDIST_HEAVY_RPC_SLOTS knob: 0 = auto (a quarter of the pool, min
+    2), negative = unbounded (None), positive = that many — always
+    leaving at least one pool thread free for control verbs."""
+    knob = int(ServiceEnv.get().tepdist_heavy_rpc_slots)
+    if knob < 0:
+        return None
+    slots = knob if knob > 0 else max(2, max_workers // 4)
+    return max(1, min(slots, max_workers - 1))
+
+
 def create_server(port: int, devices=None, task_index: int = 0,
                   max_workers: int = 32):
-    """Real gRPC server over generic (bytes-in/bytes-out) handlers."""
+    """Real gRPC server over generic (bytes-in/bytes-out) handlers.
+
+    Async-executor posture: the sync gRPC server runs every RPC on a
+    shared thread pool, so one burst of long ExecuteStepSlice handlers
+    used to occupy every pool thread and heartbeats queued behind
+    minute-long executes (heartbeat-latency failure detection degraded to
+    RPC-deadline latency). Heavy verbs now acquire a bounded semaphore
+    (heavy_rpc_slots) before running; control verbs bypass it."""
     import grpc
 
     servicer = TepdistServicer(devices, task_index)
+    slots = heavy_rpc_slots(max_workers)
+    gate = threading.BoundedSemaphore(slots) if slots is not None else None
     handlers = {}
     for m in protocol.METHODS:
         fn = getattr(servicer, m)
 
         def make(fn=fn, m=m):
+            heavy = gate is not None and m in HEAVY_VERBS
+
             def handler(request, context):
                 try:
                     # Ledger handler timing: the gRPC analogue of the
                     # in-proc server_scope (rpc/inproc.py _call_once).
                     with wire_ledger.server_scope(m):
-                        return fn(request, context)
+                        if heavy:
+                            with gate:
+                                resp = fn(request, context)
+                        else:
+                            resp = fn(request, context)
+                    if isinstance(resp, protocol.Frames):
+                        # Handlers may return scatter-gather frames; the
+                        # channel boundary is where they materialize.
+                        resp = resp.join()
+                    return resp
                 except Exception as e:  # surface server errors to client
                     log.exception("RPC failed")
                     import grpc as _g

@@ -195,7 +195,18 @@ class StageModuleRuntime:
                 else:
                     cots.append(jnp.zeros(av.shape, av.dtype))
             _, vjp_fn = jax.vjp(fwd, *ins)
-            return vjp_fn(list(cots))  # jaxpr_as_fun returns a list
+            outs = vjp_fn(list(cots))  # jaxpr_as_fun returns a list
+            # Integer/bool stage inputs (token ids) get float0 cotangents
+            # — concrete numpy arrays, not jax Arrays. Returned as-is
+            # they disqualify EVERY bwd call from the C++ jit fast path
+            # (all outputs must be jax Arrays), and poison the downstream
+            # ga call's argument signature the same way: each backward
+            # re-resolves through the Python pjit path, ~10x the
+            # dispatch cost. No consumer ever reads an integer input's
+            # cotangent, so substitute real zeros of the same shape.
+            return [jnp.zeros(np.shape(o), jnp.float32)
+                    if getattr(o, "dtype", None) == jax.dtypes.float0
+                    else o for o in outs]
 
         self._bwd = jax.jit(bwd)
 
@@ -249,6 +260,14 @@ class WorkerPlan:
             self._device_xfer = env_knob != "0"
         else:
             self._device_xfer = jax.default_backend() != "cpu"
+        # Host-push hot-path knobs, latched at plan build (core/
+        # service_env.py): overlap result serde + the peer RPC with the
+        # tail of compute (async send pool), and the opt-in lossy wire
+        # dtype for f32/f64 activation payloads.
+        from tepdist_tpu.core.service_env import ServiceEnv
+        _env = ServiceEnv.get()
+        self._send_overlap = bool(_env.tepdist_send_overlap)
+        self._wire_dtype = _env.tepdist_wire_dtype or None
         # Peer-visible address of our transfer server: the bind address is
         # "[::]:port" — advertise our cluster ip instead.
         self._xfer_addr = None
@@ -400,7 +419,15 @@ class WorkerPlan:
                 if debug:
                     log.info("[task] %s#%d stage=%s %.3f ms", task["name"],
                              tid, s, sp.dur_ms)
-            self._join_sends()
+            try:
+                self._join_sends()
+            except Exception:
+                # A failed async send gets the same cleanup as a failed
+                # task: cancel queued sends and discard the staged writes
+                # (committed state stays at the previous step, so a retry
+                # recomputes bit-identically from the kept store entries).
+                self._abandon_step(step)
+                raise
             self._commit_staged()
             self.raw.clear_step(step)
             # ONE host round trip for all micro losses.
@@ -451,34 +478,17 @@ class WorkerPlan:
                     elif self._device_xfer and self._send_device_direct(
                             peer_worker, key, val, step):
                         pass
+                    elif self._send_overlap:
+                        # Overlap result serde (device_get + encode +
+                        # pack) and the peer RPC with the tail of this
+                        # worker's compute: the consumer's blocking recv
+                        # orders arrival, and a failure surfaces at
+                        # _join_sends as the same transport error the
+                        # synchronous path raised from the task loop.
+                        self._send_futures.append(self._send_pool.submit(
+                            self._send_host_push, peer_worker, key, val))
                     else:
-                        from tepdist_tpu.rpc import protocol
-
-                        if isinstance(val, tuple):  # GA accumulator bundles
-                            metas, blobs = [], []
-                            for v in val:
-                                m, b = protocol.encode_literal(
-                                    np.asarray(jax.device_get(v)))
-                                metas.append(m)
-                                blobs.append(b)
-                            payload = protocol.pack(
-                                {"raw_key": key, "plan_gen": self.plan_gen,
-                                 "literals": metas}, blobs)
-                        else:
-                            meta_l, blob = protocol.encode_literal(
-                                np.asarray(jax.device_get(val)))
-                            payload = protocol.pack(
-                                {"raw_key": key, "plan_gen": self.plan_gen,
-                                 "literal": meta_l}, [blob])
-                        # Abort-aware peer send: a bounded timeout (matching
-                        # the recv wait) instead of the 300s RPC default,
-                        # and an abort check so a cancelled step doesn't pin
-                        # this worker inside a send to a dead/stuck peer.
-                        if self.raw._aborted:
-                            raise StepAbortedError(
-                                f"step aborted before send {key!r}")
-                        self._peer(peer_worker).stub.call(
-                            "TransferHostRawData", payload, timeout=60.0)
+                        self._send_host_push(peer_worker, key, val)
             elif tt == "recv":
                 parent = task["input_specs"].get("0")
                 if parent is not None and parent[0] in outputs:
@@ -533,6 +543,40 @@ class WorkerPlan:
             # GC: release buffers whose last (scheduled) consumer just ran.
             for rid in task.get("mem_to_release", []):
                 outputs.pop(rid, None)
+
+    def _send_host_push(self, peer_worker: int, key: str, val) -> None:
+        """Host-path peer send: device_get + encode (with the opt-in
+        TEPDIST_WIRE_DTYPE down-cast for f32/f64 payloads) + scatter-
+        gather pack + ONE TransferHostRawData to the consumer's store.
+        Runs on the send pool under TEPDIST_SEND_OVERLAP (default), or
+        inline from the task loop when the overlap is off."""
+        from tepdist_tpu.rpc import protocol
+
+        wd = self._wire_dtype
+        if isinstance(val, tuple):  # GA accumulator bundles
+            metas, blobs = [], []
+            for v in val:
+                m, b = protocol.encode_literal(
+                    np.asarray(jax.device_get(v)), wire_dtype=wd)
+                metas.append(m)
+                blobs.append(b)
+            payload = protocol.pack_frames(
+                {"raw_key": key, "plan_gen": self.plan_gen,
+                 "literals": metas}, blobs)
+        else:
+            meta_l, blob = protocol.encode_literal(
+                np.asarray(jax.device_get(val)), wire_dtype=wd)
+            payload = protocol.pack_frames(
+                {"raw_key": key, "plan_gen": self.plan_gen,
+                 "literal": meta_l}, [blob])
+        # Abort-aware peer send: a bounded timeout (matching the recv
+        # wait) instead of the 300s RPC default, and an abort check so a
+        # cancelled step doesn't pin this worker inside a send to a
+        # dead/stuck peer.
+        if self.raw._aborted:
+            raise StepAbortedError(f"step aborted before send {key!r}")
+        self._peer(peer_worker).stub.call(
+            "TransferHostRawData", payload, timeout=60.0)
 
     def _send_device_direct(self, peer_worker: int, key: str, val,
                             step: int) -> bool:

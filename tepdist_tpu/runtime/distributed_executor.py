@@ -314,6 +314,86 @@ class DistributedPipelineSession:
             return self._step_body(*batch)
 
     def _step_body(self, *batch) -> float:
+        from tepdist_tpu.core.service_env import ServiceEnv
+        if ServiceEnv.get().tepdist_batch_dispatch:
+            return self._step_coalesced(batch)
+        return self._step_per_verb(batch)
+
+    def _step_coalesced(self, batch) -> float:
+        """Coalesced dispatch (TEPDIST_BATCH_DISPATCH, default on): ONE
+        ExecuteStepSlice RPC per worker carries its whole per-step task
+        slice — every micro-batch slice it consumes plus the execute
+        trigger — and its losses come back in the same reply envelope
+        (cf. coalesced MPMD dispatch, arXiv:2412.14374). Per-worker
+        envelopes are sliced + encoded on THIS thread and each worker's
+        dispatch thread starts immediately after its pack, so packing
+        worker k+1 overlaps the RPC and compute of workers <= k
+        (send-side overlap; the legacy path packed everything before
+        triggering anything). Push and execute failures land in ONE
+        errors dict feeding the same _recover_step ladder — batch slices
+        re-encode on retry, and the worker-side completed-step cache +
+        idempotent keyed puts keep replays bit-identical."""
+        prog = self.prog
+        M = prog.num_micro_batches
+        bdim = prog.batch_dim
+        leaves = jax.tree_util.tree_leaves(batch)
+        step = self._step
+        by_worker: Dict[int, List[int]] = {}
+        for s, gis in self._batch_stages.items():
+            by_worker.setdefault(self.stage_worker[s], []).extend(gis)
+        results: Dict[int, dict] = {}
+        errors: Dict[int, Exception] = {}
+        threads: List[threading.Thread] = []
+
+        def run(ti, client, header, blobs):
+            try:
+                resp = client.call("ExecuteStepSlice", header, blobs)
+                r, _ = protocol.unpack(resp)
+                if not r.get("ok", False):
+                    raise RuntimeError(
+                        f"worker {ti} dropped step {step}: stale plan "
+                        f"generation {r.get('stale_plan_gen')}")
+                results[ti] = r
+            except Exception as e:  # noqa: BLE001
+                errors[ti] = e
+
+        with wire_ledger.client_scope("master:dispatch"):
+            for ti, client in self.clients.items():
+                entries: List[dict] = []
+                blobs: List[bytes] = []
+                for gi in by_worker.get(ti, ()):
+                    leaf = np.asarray(leaves[gi - self._n_params])
+                    msize = leaf.shape[bdim] // M
+                    for m in range(M):
+                        sl = np.take(leaf,
+                                     range(m * msize, (m + 1) * msize),
+                                     axis=bdim)
+                        meta, blob = protocol.encode_literal(sl)
+                        entries.append(
+                            {"raw_key": f"batch:{step}:{m}:{gi}",
+                             "literal": meta})
+                        blobs.append(blob)
+                t = threading.Thread(
+                    target=run,
+                    args=(ti, client,
+                          {"step": step, "plan_gen": self._plan_gen,
+                           "raw_multi": entries}, blobs),
+                    daemon=True)
+                threads.append(t)
+                t.start()
+            self._join_with_heartbeat(threads, errors)
+        # Snapshot: abandoned daemon threads (still blocked past the grace
+        # join) may write into `errors` while we iterate it below.
+        errors = dict(errors)
+        if errors:
+            return self._recover_step(errors, batch, threads=threads)
+        return self._finish_step(results)
+
+    def _step_per_verb(self, batch) -> float:
+        """Legacy per-verb dispatch (TEPDIST_BATCH_DISPATCH=0): one
+        TransferHostRawData push per consuming (stage, leaf), then one
+        ExecuteRemotePlan per worker. Kept both as the coalescing
+        baseline (bench: dispatch_coalesce_x) and as the fallback knob."""
         prog = self.prog
         M = prog.num_micro_batches
         bdim = prog.batch_dim
@@ -384,6 +464,9 @@ class DistributedPipelineSession:
         errors = dict(errors)
         if errors:
             return self._recover_step(errors, batch, threads=threads)
+        return self._finish_step(results)
+
+    def _finish_step(self, results: Dict[int, dict]) -> float:
         self._step += 1
         self._redispatch_attempts = 0   # a full step succeeded: reset cap
         self._step_attempts = 0

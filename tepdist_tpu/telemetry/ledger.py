@@ -59,7 +59,11 @@ _CATS = ("serde", "rpc", "handler")
 _STAT_KEYS = ("calls", "retries", "backoff_us",
               "tx_header_bytes", "tx_blob_bytes",
               "rx_header_bytes", "rx_blob_bytes",
-              "encode_us", "decode_us", "client_us", "server_us")
+              "encode_us", "decode_us", "client_us", "server_us",
+              # Buffer materializations in encode_literal (PR 11): 0 on
+              # the zero-copy path, 1 per non-contiguous input or wire
+              # down-cast. merge() tolerates old snapshots without it.
+              "copies")
 
 
 def _new_stats() -> Dict[str, float]:
@@ -247,11 +251,13 @@ class RpcLedger:
                 s["decode_us"] += t1_us - t0_us
             self._add_iv("serde", t0_us, t1_us)
 
-    def record_encode(self, t0_us: int, t1_us: int) -> None:
+    def record_encode(self, t0_us: int, t1_us: int,
+                      copies: int = 0) -> None:
         tls = _TLS
         with self._lock:
             for s in self._verb_stats(tls.verb, tls.step):
                 s["encode_us"] += t1_us - t0_us
+                s["copies"] += copies
             self._add_iv("serde", t0_us, t1_us)
 
     def record_decode(self, t0_us: int, t1_us: int) -> None:

@@ -1,0 +1,348 @@
+"""RPC hot-path tests (ISSUE 11): zero-copy scatter-gather framing,
+coalesced ExecuteStepSlice dispatch, send-side overlap knobs, and the
+bounded async server executor.
+
+Covers the acceptance points checkable without a multi-process fleet:
+Frames/bytes envelope parity (join, unpack, peek_header), framing fuzz
+(every truncation point, forged >2 GiB blob lengths, memoryview vs bytes
+payloads), literal serde zero-copy proofs and the ledger ``copies``
+counter, the opt-in bf16 wire down-cast, heavy-slot resolution, and —
+on the two-worker in-proc fleet — bit-identical losses with batched
+dispatch on vs off plus exact ledger byte accounting under
+ExecuteStepSlice (tx header + blob bytes == every framed length, to the
+byte).
+"""
+
+import os
+import struct
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tepdist_tpu.rpc import protocol
+from tepdist_tpu.telemetry import ledger as ledger_mod
+from tepdist_tpu.telemetry.ledger import RpcLedger
+
+
+@pytest.fixture()
+def private_ledger():
+    """Swap a private enabled ledger in for the module global (the
+    test_ledger.py fixture) so accounting assertions neither observe nor
+    disturb the process-wide instrument."""
+    prev = ledger_mod.ledger()
+    led = RpcLedger(enabled=True)
+    ledger_mod._LEDGER = led
+    yield led
+    ledger_mod._LEDGER = prev
+
+
+@pytest.fixture()
+def service_env_knob():
+    """Set ServiceEnv knobs for one test, restoring priors on exit."""
+    from tepdist_tpu.core.service_env import ServiceEnv
+
+    env = ServiceEnv.get()
+    saved = {}
+
+    def set_knob(name, value):
+        if name not in saved:
+            saved[name] = getattr(env, name.lower())
+        env.set(name, value)
+
+    yield set_knob
+    for name, value in saved.items():
+        env.set(name, value)
+
+
+# ---------------------------------------------------------------------------
+# Envelope: Frames vs joined bytes parity
+
+
+def _sample_envelope():
+    header = {"step": 3, "plan_gen": 1,
+              "raw_multi": [{"raw_key": f"k{i}"} for i in range(3)]}
+    rng = np.random.RandomState(7)
+    blobs = [rng.bytes(n) for n in (0, 13, 4096)]
+    return header, blobs
+
+
+def test_frames_join_matches_pack_bytes():
+    header, blobs = _sample_envelope()
+    frames = protocol.pack_frames(header, blobs)
+    joined = protocol.pack(header, blobs)
+    assert frames.join() == joined
+    assert len(frames) == len(joined)
+    assert frames.header_bytes + frames.blob_bytes == len(joined)
+    # join() caches: a retry replays the identical buffer object.
+    assert frames.join() is frames.join()
+
+
+def test_unpack_frames_equals_unpack_bytes():
+    header, blobs = _sample_envelope()
+    frames = protocol.pack_frames(header, blobs)
+    h_f, b_f = protocol.unpack(frames)
+    h_b, b_b = protocol.unpack(frames.join())
+    assert h_f == header and h_b == header
+    assert [bytes(b) for b in b_f] == blobs
+    assert [bytes(b) for b in b_b] == blobs
+
+
+def test_peek_header_parity_and_silence(private_ledger):
+    header, blobs = _sample_envelope()
+    frames = protocol.pack_frames(header, blobs)
+    private_ledger.clear()
+    assert protocol.peek_header(frames) == header
+    assert protocol.peek_header(frames.join()) == header
+    # peek_header is transport-layer introspection: it must record
+    # NOTHING (the handler's own unpack is the one accounted parse).
+    assert private_ledger.snapshot()["verbs"] == {}
+
+
+def test_peek_header_rejects_garbage():
+    with pytest.raises(ValueError, match="magic"):
+        protocol.peek_header(b"NOPE" + b"\x00" * 32)
+    frame = protocol.pack({"step": 1, "plan_gen": 2, "pad": "x" * 32})
+    with pytest.raises(ValueError, match="truncated"):
+        protocol.peek_header(frame[:16])
+
+
+# ---------------------------------------------------------------------------
+# Framing fuzz
+
+
+def test_truncation_at_every_cut_point():
+    """Every proper prefix of a frame raises ValueError at the decode
+    site — never a downstream np.frombuffer shape error."""
+    msg = protocol.pack({"a": 1, "b": "xy"}, [b"", b"p" * 37, b"q" * 8])
+    for cut in range(len(msg)):
+        with pytest.raises(ValueError):
+            protocol.unpack(msg[:cut])
+    header, blobs = protocol.unpack(msg)
+    assert header == {"a": 1, "b": "xy"} and len(blobs) == 3
+
+
+def test_forged_huge_blob_length_rejected():
+    """A forged u64 blob length (>2 GiB, way past the buffer) must be
+    caught by the bounds check, not attempted as an allocation."""
+    payload = b"z" * 64
+    msg = bytearray(protocol.pack({"a": 1}, [payload]))
+    # The length prefix is the 8 bytes immediately before the payload.
+    off = len(msg) - len(payload) - 8
+    assert struct.unpack_from("<Q", msg, off)[0] == len(payload)
+    struct.pack_into("<Q", msg, off, 2**33)
+    with pytest.raises(ValueError, match="truncated"):
+        protocol.unpack(bytes(msg))
+
+
+def test_memoryview_and_bytes_blobs_pack_identically():
+    raw = bytes(range(256)) * 4
+    as_bytes = protocol.pack({"k": 1}, [raw])
+    as_view = protocol.pack({"k": 1}, [memoryview(raw)])
+    assert as_bytes == as_view
+    # Non-contiguous views (e.g. a strided slice) still frame correctly
+    # — the transport needs contiguous buffers, so these copy.
+    strided = memoryview(raw)[::2]
+    assert not strided.c_contiguous
+    framed = protocol.pack({"k": 1}, [strided])
+    _, blobs = protocol.unpack(framed)
+    assert bytes(blobs[0]) == bytes(strided)
+
+
+def test_empty_blob_frames_round_trip():
+    frames = protocol.pack_frames({"only": "header"})
+    h, b = protocol.unpack(frames)
+    assert h == {"only": "header"} and list(b) == []
+    h2, b2 = protocol.unpack(frames.join())
+    assert h2 == {"only": "header"} and list(b2) == []
+
+
+# ---------------------------------------------------------------------------
+# Literal serde: zero-copy, copies counter, dtype round trips
+
+
+def test_encode_literal_zero_copy_for_contiguous():
+    arr = np.arange(1024, dtype=np.float32).reshape(32, 32)
+    meta, blob = protocol.encode_literal(arr)
+    assert np.shares_memory(np.frombuffer(blob, dtype=np.uint8), arr)
+    back = protocol.decode_literal(meta, blob)
+    assert back.dtype == np.float32
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_literal_dtype_round_trips():
+    import ml_dtypes
+
+    for arr in [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.arange(12, dtype=np.float32).astype(ml_dtypes.bfloat16),
+        np.arange(5, dtype=np.int32),
+        np.array(2.5, dtype=np.float64),          # 0-d scalar
+        np.array([True, False, True]),
+    ]:
+        meta, blob = protocol.encode_literal(arr)
+        back = protocol.decode_literal(meta, bytes(blob))
+        assert back.dtype == arr.dtype
+        assert back.shape == arr.shape
+        np.testing.assert_array_equal(np.asarray(back, np.float64),
+                                      np.asarray(arr, np.float64))
+
+
+def test_copies_counter_counts_materializations(private_ledger):
+    contiguous = np.ones((8, 8), dtype=np.float32)
+    protocol.encode_literal(contiguous)
+    snap = private_ledger.snapshot()
+    assert snap["verbs"]["_unattributed"]["copies"] == 0
+
+    protocol.encode_literal(contiguous.T)          # non-contiguous: 1 copy
+    snap = private_ledger.snapshot()
+    assert snap["verbs"]["_unattributed"]["copies"] == 1
+
+    protocol.encode_literal(contiguous, wire_dtype="bfloat16")  # down-cast
+    snap = private_ledger.snapshot()
+    assert snap["verbs"]["_unattributed"]["copies"] == 2
+
+
+def test_bf16_wire_halves_blob_and_upcasts_on_decode():
+    arr = np.linspace(-2.0, 2.0, 512, dtype=np.float32).reshape(16, 32)
+    meta32, blob32 = protocol.encode_literal(arr)
+    meta16, blob16 = protocol.encode_literal(arr, wire_dtype="bfloat16")
+    assert protocol._nbytes(blob16) * 2 == protocol._nbytes(blob32)
+    assert meta16["wire_from"] == "float32"
+    back = protocol.decode_literal(meta16, bytes(blob16))
+    assert back.dtype == np.float32                # upcast at the far end
+    np.testing.assert_allclose(back, arr, rtol=1e-2, atol=1e-2)
+    # Integer payloads are never down-cast.
+    ints = np.arange(16, dtype=np.int32)
+    meta_i, _ = protocol.encode_literal(ints, wire_dtype="bfloat16")
+    assert meta_i["dtype"] == "int32" and "wire_from" not in meta_i
+
+
+def test_bf16_wire_halves_ledger_tx_blob(private_ledger):
+    arr = np.ones((64, 64), dtype=np.float32)
+    _, blob = protocol.encode_literal(arr)
+    protocol.pack_frames({"raw_key": "k"}, [blob])
+    full = private_ledger.snapshot(clear=True)
+    _, blob16 = protocol.encode_literal(arr, wire_dtype="bfloat16")
+    protocol.pack_frames({"raw_key": "k"}, [blob16])
+    half = private_ledger.snapshot()
+    tx = lambda s: s["verbs"]["_unattributed"]["tx_blob_bytes"]  # noqa: E731
+    assert tx(full) == arr.nbytes
+    assert tx(half) * 2 == tx(full)
+
+
+# ---------------------------------------------------------------------------
+# Bounded async server executor
+
+
+def test_heavy_rpc_slots_resolution(service_env_knob):
+    from tepdist_tpu.rpc.server import HEAVY_VERBS, heavy_rpc_slots
+
+    assert "ExecuteStepSlice" in HEAVY_VERBS
+    assert "Ping" not in HEAVY_VERBS and "AbortStep" not in HEAVY_VERBS
+
+    service_env_knob("TEPDIST_HEAVY_RPC_SLOTS", 0)      # auto
+    assert heavy_rpc_slots(32) == 8                     # 32 // 4
+    assert heavy_rpc_slots(4) == 2                      # floor of 2
+    assert heavy_rpc_slots(2) == 1                      # always leave one free
+    service_env_knob("TEPDIST_HEAVY_RPC_SLOTS", -1)     # unbounded
+    assert heavy_rpc_slots(32) is None
+    service_env_knob("TEPDIST_HEAVY_RPC_SLOTS", 5)      # explicit
+    assert heavy_rpc_slots(32) == 5
+    assert heavy_rpc_slots(4) == 3                      # clamped to mw - 1
+
+
+# ---------------------------------------------------------------------------
+# Two-worker in-proc fleet: dispatch parity + ledger exactness
+
+
+def _mlp_fixture():
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, x, y):
+        h = x
+        for i in range(4):
+            h = jnp.tanh(h @ params[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    keys = jax.random.split(jax.random.PRNGKey(0), 6)
+    params = {f"w{i}": jax.random.normal(keys[i], (16, 16)) * 0.3
+              for i in range(4)}
+    x = jax.random.normal(keys[4], (8, 16))
+    y = jax.random.normal(keys[5], (8, 16))
+    return loss_fn, params, x, y
+
+
+def _run_fleet_losses(steps, set_knob=None):
+    import jax
+    import optax
+
+    from tepdist_tpu.parallel.pipeline import plan_pipeline
+    from tepdist_tpu.rpc.inproc import (close_inproc_cluster,
+                                        make_inproc_cluster)
+    from tepdist_tpu.runtime.distributed_executor import (
+        DistributedPipelineSession,
+    )
+
+    loss_fn, params, x, y = _mlp_fixture()
+    prog = plan_pipeline(loss_fn, 2, 2, params, x, y)
+    cluster, _ = make_inproc_cluster(2, jax.devices()[:1])
+    try:
+        sess = DistributedPipelineSession(prog, cluster,
+                                          optimizer=optax.sgd(1e-2))
+        sess.load_variables(params)
+        if set_knob is not None:
+            set_knob()
+        losses = [sess.step(x, y) for _ in range(steps)]
+        sess.close()
+        return losses
+    finally:
+        close_inproc_cluster(cluster)
+
+
+def test_batched_dispatch_losses_bit_identical(service_env_knob):
+    """ISSUE 11 chaos-parity corollary: coalesced ExecuteStepSlice
+    dispatch re-packages the SAME pushes + execute — the training
+    trajectory must match the per-verb path bit for bit."""
+    service_env_knob("TEPDIST_BATCH_DISPATCH", False)
+    legacy = _run_fleet_losses(4)
+    service_env_knob("TEPDIST_BATCH_DISPATCH", True)
+    coalesced = _run_fleet_losses(4)
+    assert legacy == coalesced                      # exact, not allclose
+
+
+def test_step_slice_ledger_byte_exactness(private_ledger, service_env_knob,
+                                          monkeypatch):
+    """Ledger byte identity on the BATCHED path: for every frame built
+    during a live two-worker session with batched dispatch + overlap on,
+    header_bytes + blob_bytes == joined frame length, and the ledger tx
+    totals equal the sum of those lengths exactly."""
+    service_env_knob("TEPDIST_BATCH_DISPATCH", True)
+
+    packed = []
+    real_pack, real_pack_frames = protocol.pack, protocol.pack_frames
+
+    def counting_pack(header, blobs=()):
+        frame = real_pack(header, blobs)
+        packed.append(len(frame))
+        return frame
+
+    def counting_pack_frames(header, blobs=()):
+        frames = real_pack_frames(header, blobs)
+        assert frames.header_bytes + frames.blob_bytes == len(frames.join())
+        packed.append(len(frames))
+        return frames
+
+    monkeypatch.setattr(protocol, "pack", counting_pack)
+    monkeypatch.setattr(protocol, "pack_frames", counting_pack_frames)
+
+    _run_fleet_losses(3)
+
+    snap = private_ledger.snapshot()
+    assert snap["verbs"].get("ExecuteStepSlice", {}).get("calls", 0) > 0
+    tx = sum(s["tx_header_bytes"] + s["tx_blob_bytes"]
+             for s in snap["verbs"].values())
+    assert tx == sum(packed)                        # exact, to the byte

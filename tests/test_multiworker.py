@@ -519,7 +519,7 @@ def test_auto_redispatch_onto_shrunken_cluster(tmp_path):
 @pytest.mark.parametrize("victim_ti", [1, 0])
 def test_mid_step_worker_death_detected_by_heartbeat(tmp_path, victim_ti):
     """NOTES_NEXT r2 gap #4: a worker dying (here: wedging, via SIGSTOP)
-    DURING ExecuteRemotePlan must be detected at heartbeat latency, not by
+    DURING its execute RPC must be detected at heartbeat latency, not by
     waiting out the 60s recv / 300s RPC timeouts. The master's
     heartbeat-polling join declares the worker dead, AbortStep wakes the
     survivor's blocked recvs, and the elastic path re-dispatches onto the
@@ -586,15 +586,15 @@ def test_mid_step_worker_death_detected_by_heartbeat(tmp_path, victim_ti):
         sess.load_variables(params)
         losses = [sess.step(x, y)]
 
-        # Wedge the victim the moment its NEXT ExecuteRemotePlan is
-        # issued: the batch pushes succeed (it is alive), then it stops
-        # mid-step.
+        # Wedge the victim the moment its NEXT execute verb is issued
+        # (ExecuteStepSlice under batched dispatch, ExecuteRemotePlan on
+        # the legacy path): it stops mid-step, after proving it is alive.
         victim_proc = {0: w0, 1: w1}[victim_ti]
         victim = sess.clients[victim_ti].stub
         orig_call = victim.call
 
         def stopping_call(method, payload, timeout=None, **kw):
-            if method == "ExecuteRemotePlan":
+            if method in ("ExecuteRemotePlan", "ExecuteStepSlice"):
                 victim_proc.send_signal(signal.SIGSTOP)
             return orig_call(method, payload, timeout=timeout, **kw)
 
@@ -795,7 +795,7 @@ def test_elastic_redispatch_at_four_workers(tmp_path):
 
 
 def test_mid_step_death_at_four_workers(tmp_path):
-    """Mid-step wedge at N=4: worker 2 SIGSTOPs during ExecuteRemotePlan;
+    """Mid-step wedge at N=4: worker 2 SIGSTOPs during its execute RPC;
     heartbeat detection + AbortStep wake the three blocked survivors and
     re-dispatch runs on all of them — none may be mis-declared dead."""
     import time as _time
@@ -819,7 +819,7 @@ def test_mid_step_death_at_four_workers(tmp_path):
         orig_call = victim.call
 
         def stopping_call(method, payload, timeout=None, **kw):
-            if method == "ExecuteRemotePlan":
+            if method in ("ExecuteRemotePlan", "ExecuteStepSlice"):
                 victim_proc.send_signal(signal.SIGSTOP)
             return orig_call(method, payload, timeout=timeout, **kw)
 

@@ -45,10 +45,13 @@ sys.path.insert(0, HERE)
 
 DEFAULT_HISTORY = os.path.join(HERE, "bench_history.jsonl")
 
-# The headline lines the gate watches by default (ISSUE pr9). --keys
-# widens or narrows the watchlist; recording always keeps everything.
+# The headline lines the gate watches by default (ISSUE pr9; the two
+# hot-path buckets joined in ISSUE 11 — scripts/hotpath_smoke.sh records
+# them from the ledger gap table). --keys widens or narrows the
+# watchlist; recording always keeps everything.
 DEFAULT_KEYS = ("two_worker_fleet_ms", "serving_tok_s",
-                "paged_capacity_x", "plan_verify_ms")
+                "paged_capacity_x", "plan_verify_ms",
+                "rpc_orchestration_ms", "serde_ms")
 
 _HIGHER_BETTER_SUFFIXES = ("tok_s", "_x", "_per_s", "_rate", "_speedup")
 _PROMOTE_SUFFIXES = ("_ms", "_us", "_x", "_pct", "tok_s", "_per_s",
