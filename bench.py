@@ -720,6 +720,56 @@ def bench_ledger_overhead(steps: int = 6, warmup: int = 2) -> dict:
     }
 
 
+def bench_explore_report(rounds: int = 3) -> dict:
+    """Exploration-observatory capture cost: min-of-rounds ``explore()``
+    wall on an abstract MLP with the observatory OFF (no collector, no
+    prune records, no report build) vs ON (full candidate ledger +
+    typed prunes + ranked report). The report is assembled from data
+    the argmin already produced, so the acceptance bound is <= 2% of
+    explore time."""
+    from tepdist_tpu.parallel.exploration import explore
+    from tepdist_tpu.telemetry import observatory
+
+    def loss_fn(params, x, y):
+        h = x
+        for i in range(4):
+            h = jnp.tanh(h @ params[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    params = {f"w{i}": jax.ShapeDtypeStruct((256, 256), jnp.float32)
+              for i in range(4)}
+    x = jax.ShapeDtypeStruct((8, 256), jnp.float32)
+    y = jax.ShapeDtypeStruct((8, 256), jnp.float32)
+
+    def explore_min_ms(obs_on: bool) -> float:
+        observatory.configure(enabled=obs_on)
+        best = float("inf")
+        for _ in range(rounds + 1):   # first round absorbs trace compile
+            t0 = time.perf_counter()
+            explore(loss_fn, params, x, y, n_devices=8,
+                    num_micro_batches=2, entry_point="bench")
+            best = min(best, time.perf_counter() - t0)
+        return best * 1e3
+
+    try:
+        off_ms = explore_min_ms(False)
+        on_ms = explore_min_ms(True)
+    finally:
+        observatory.configure(enabled=True)   # observatory defaults ON
+    report_ms = max(on_ms - off_ms, 0.0)
+    pct = (report_ms / off_ms * 100.0) if off_ms else 0.0
+    return {
+        "metric": "explore_report_ms",
+        "value": round(report_ms, 3),
+        "unit": "ms of explore() spent on report capture (min-of-rounds,"
+                " observatory on vs off)",
+        "explore_off_ms": round(off_ms, 3),
+        "explore_on_ms": round(on_ms, 3),
+        "pct_of_explore": round(pct, 2),
+        "gate_below_2pct": bool(pct <= 2.0),
+    }
+
+
 def bench_serving(n_requests: int = 16, rounds: int = 3) -> dict:
     """Continuous-batching serving throughput (tepdist_tpu/serving/):
     one engine, mixed prompt/output lengths, decode tokens/s with the
@@ -946,6 +996,11 @@ def main() -> None:
         except Exception:
             extra.append({"metric": "ledger_overhead_pct", "error":
                           traceback.format_exc(limit=3).splitlines()[-1]})
+        try:
+            extra.append(bench_explore_report())
+        except Exception:
+            extra.append({"metric": "explore_report_ms", "error":
+                          traceback.format_exc(limit=3).splitlines()[-1]})
         # Carry forward the last TPU round's secondary lines STALE-FLAGGED
         # (mirroring the headline policy) instead of silently dropping
         # them: the fresh runtime line replaces only its own metric.
@@ -1010,6 +1065,7 @@ def main() -> None:
     selected = {
         "trace": bench_trace_overhead,   # ~ms; telemetry no-op guarantee
         "ledger": bench_ledger_overhead,  # RPC ledger+flight hook cost
+        "explore": bench_explore_report,  # observatory capture cost
         "serving": bench_serving,        # continuous-batching decode tok/s
         "paged": bench_paged_capacity,   # paged-vs-slots admission capacity
         "117m": lambda: bench_gpt2_117m(True),

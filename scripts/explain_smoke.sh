@@ -1,0 +1,120 @@
+#!/usr/bin/env bash
+# Exploration-observatory smoke: prove the PR-12 decision-forensics
+# pipeline end to end.
+#
+#   1. LEDGER + SCOREBOARD: tools/plan_explain.py --fixture runs the
+#      real two-worker in-proc fleet, and --check fails unless every
+#      enumerated proposal is accounted (priced candidate or typed
+#      prune) AND the executed candidate's predicted cost terms join
+#      against the measured fidelity attribution.
+#   2. PLAN DIFF: two identical explores diff empty (--check passes);
+#      a seeded cost-model perturbation (tiny HBM makes full
+#      replication infeasible) MUST flip the winner with a named
+#      driver (--expect-flip).
+#   3. PERF GATE: three recordings of the report-capture time build a
+#      rolling baseline; --check passes, a seeded 50% regression MUST
+#      trip, and --plan-diff MUST fail the gate on a winner flip with
+#      no bench improvement while passing on identical reports.
+#
+# Override the per-pass bound with EXPLAIN_SMOKE_TIMEOUT (seconds).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${EXPLAIN_SMOKE_TIMEOUT:-600}"
+TMPDIR_SMOKE="$(mktemp -d)"
+trap 'rm -rf "$TMPDIR_SMOKE"' EXIT
+export JAX_PLATFORMS=cpu
+
+echo "=== explain smoke 1/3: candidate ledger + cost scoreboard ==="
+timeout -k 10 "$TIMEOUT" python tools/plan_explain.py --fixture --check
+
+echo "=== explain smoke 2/3: plan diff — identical empty, seeded flip ==="
+timeout -k 10 "$TIMEOUT" env \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - "$TMPDIR_SMOKE" <<'PY'
+import json, os, sys
+
+import jax
+import jax.numpy as jnp
+
+from tepdist_tpu.core.service_env import ServiceEnv
+from tepdist_tpu.parallel.exploration import explore
+
+out = sys.argv[1]
+
+def loss(params, x, y):
+    h = x
+    for i in range(4):
+        h = jnp.tanh(h @ params[f"w{i}"])
+    return jnp.mean((h - y) ** 2)
+
+params = {f"w{i}": jax.ShapeDtypeStruct((1024, 1024), jnp.float32)
+          for i in range(4)}
+x = jax.ShapeDtypeStruct((8, 1024), jnp.float32)
+y = jax.ShapeDtypeStruct((8, 1024), jnp.float32)
+
+def report(**env):
+    try:
+        if env:
+            ServiceEnv.reset(env)
+        return explore(loss, params, x, y, n_devices=8,
+                       num_micro_batches=2)["report"]
+    finally:
+        if env:
+            ServiceEnv.reset()
+
+# base / again: identical fixture twice (determinism contract);
+# perturbed: tiny HBM makes full replication memory-infeasible.
+for name, rep in (("base", report()), ("again", report()),
+                  ("perturbed", report(HBM_GB=0.005))):
+    with open(os.path.join(out, f"{name}.json"), "w") as f:
+        json.dump(rep, f)
+PY
+
+timeout -k 10 "$TIMEOUT" python tools/plan_diff.py \
+    "$TMPDIR_SMOKE/base.json" "$TMPDIR_SMOKE/again.json" --check
+if timeout -k 10 "$TIMEOUT" python tools/plan_diff.py \
+    "$TMPDIR_SMOKE/base.json" "$TMPDIR_SMOKE/perturbed.json" --check \
+    > /dev/null 2>&1; then
+    echo "explain smoke: FAIL (seeded flip did not fail plan_diff --check)"
+    exit 1
+fi
+timeout -k 10 "$TIMEOUT" python tools/plan_diff.py \
+    "$TMPDIR_SMOKE/base.json" "$TMPDIR_SMOKE/perturbed.json" --expect-flip
+
+echo "=== explain smoke 3/3: perf gate — capture metric + flip gating ==="
+HIST="$TMPDIR_SMOKE/bench_history.jsonl"
+CAP_MS="$(python - "$TMPDIR_SMOKE/base.json" <<'PY'
+import json, sys
+print(json.load(open(sys.argv[1]))["capture_ms"])
+PY
+)"
+for i in 1 2 3; do
+    timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST" \
+        --record-value "explore_report_ms=$CAP_MS" > /dev/null
+done
+timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST" \
+    --check --keys explore_report_ms \
+    --record-value "explore_report_ms=$CAP_MS"
+if timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST" \
+    --check --keys explore_report_ms \
+    --record-value "explore_report_ms=$CAP_MS" \
+    --seed-regression explore_report_ms:50; then
+    echo "explain smoke: FAIL (seeded 50% regression did not trip the gate)"
+    exit 1
+fi
+# A winner flip with no bench improvement is an unexplained plan change.
+if timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST" \
+    --check --keys explore_report_ms \
+    --record-value "explore_report_ms=$CAP_MS" \
+    --plan-diff "$TMPDIR_SMOKE/base.json,$TMPDIR_SMOKE/perturbed.json"; then
+    echo "explain smoke: FAIL (uncovered winner flip did not trip the gate)"
+    exit 1
+fi
+# Identical reports carry no flip: the same gate passes.
+timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST" \
+    --check --keys explore_report_ms \
+    --record-value "explore_report_ms=$CAP_MS" \
+    --plan-diff "$TMPDIR_SMOKE/base.json,$TMPDIR_SMOKE/again.json"
+
+echo "explain smoke: PASS"

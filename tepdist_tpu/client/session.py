@@ -130,6 +130,10 @@ class TepdistSession:
                                                 variable=True)
         self.client.transfer_var_arg_map(
             {i: i for i in range(self._n_state)})
+        # Server-side exploration's decision record (telemetry/
+        # observatory.py) — kept for dump_trace() metadata embedding.
+        self.exploration_report = (
+            (resp["summary"].get("explored") or {}).get("report"))
         return resp["summary"]
 
     # ------------------------------------------------------------------
@@ -336,9 +340,17 @@ class TepdistSession:
         ``$TEPDIST_DUMP_DIR`` (core/debug_dump.py policy). Returns the
         written path, or None if the dump could not be written. Requires
         ``TEPDIST_TRACE=1`` (or DEBUG) on both processes for a non-empty
-        timeline."""
+        timeline. When the plan came from server-side exploration, the
+        decision record rides in ``metadata.exploration`` (next to
+        ``metadata.fidelity``) so the trace file is a self-contained
+        plan_explain/fidelity input."""
         from tepdist_tpu.telemetry import dump_merged_trace
-        return dump_merged_trace([self.client], path=path, name="trace")
+        extra = None
+        report = getattr(self, "exploration_report", None)
+        if report:
+            extra = {"exploration": report}
+        return dump_merged_trace([self.client], path=path, name="trace",
+                                 extra_metadata=extra)
 
     def close(self) -> None:
         # Drain queued async steps before the channel goes away.

@@ -125,6 +125,12 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
     ("LOWERING_POSTCHECK", bool, True, "winner-only involuntary-remat "
      "lowering check after exploration (parallel/lowering_check.py); "
      "records the involuntary_remat counter + a warning"),
+    ("TEPDIST_PLAN_REPORT", str, "", "path (file or directory) the "
+     "exploration observatory (telemetry/observatory.py) writes each "
+     "ExplorationReport JSON to — the full candidate ledger, typed "
+     "prune records, winner rationale; rendered by tools/plan_explain.py "
+     "and compared by tools/plan_diff.py. Empty: report still rides the "
+     "explore RPC and trace metadata, just not persisted standalone"),
     ("TEPDIST_LEDGER", bool, False, "per-verb RPC wire/serde ledger "
      "(telemetry/ledger.py): call counts, header vs blob bytes, "
      "encode/decode wall time, handler time, retry backoff — reduced to "

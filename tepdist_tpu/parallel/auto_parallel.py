@@ -476,18 +476,37 @@ def auto_parallel_explore(
                                         *example_args)
     else:
         price_graph = graph
-    candidates = spmd_candidates(price_graph, num_devices, annotations,
-                                 num_micro_batches)
-    if scalar_loss:
-        params, *batch = example_args
-        batch_rows = jax.tree_util.tree_leaves(batch)[0].shape[0]
-        candidates += seq_candidates(price_graph, num_devices, batch_rows)
-        candidates += pipeline_candidates(
-            fn, params, tuple(batch), num_devices, batch_rows,
-            num_micro_batches if num_micro_batches > 1 else 4)
+    # This entry point calls the enumerators directly (it lowers its own
+    # winner), so it opens its own observatory capture — the report
+    # lands on the returned plan as ``plan.exploration_report``.
+    from tepdist_tpu.telemetry import observatory
+    import time as _time
+
+    with observatory.capture("auto_parallel_explore") as _col:
+        _t0 = _time.perf_counter()
+        candidates = spmd_candidates(price_graph, num_devices, annotations,
+                                     num_micro_batches)
+        if _col is not None:
+            _col.phase("spmd", _time.perf_counter() - _t0)
+        if scalar_loss:
+            params, *batch = example_args
+            batch_rows = jax.tree_util.tree_leaves(batch)[0].shape[0]
+            _t0 = _time.perf_counter()
+            candidates += seq_candidates(price_graph, num_devices,
+                                         batch_rows)
+            if _col is not None:
+                _col.phase("seq", _time.perf_counter() - _t0)
+            _t0 = _time.perf_counter()
+            candidates += pipeline_candidates(
+                fn, params, tuple(batch), num_devices, batch_rows,
+                num_micro_batches if num_micro_batches > 1 else 4)
+            if _col is not None:
+                _col.phase("pipeline", _time.perf_counter() - _t0)
+    excluded = [] if scalar_loss else ["seq", "pipeline"]
     if not candidates:
         raise RuntimeError("no feasible topology proposal")
 
+    fallbacks = []
     for best in sorted(candidates, key=lambda c: c["cost"].key()):
         try:
             plan = _materialize_explored(
@@ -498,10 +517,23 @@ def auto_parallel_explore(
             log.warning("winner %s failed to materialize (%s); trying "
                         "the runner-up", best.get("topology", best["kind"]),
                         e)
+            fallbacks.append({
+                "config": observatory.candidate_config(best),
+                "exc_type": type(e).__name__, "message": str(e)[:300]})
             continue
         log.info("exploration winner: %s (duration %.3e s/step) of %d "
                  "proposals", best["kind"], best["cost"].total_duration,
                  len(candidates))
+        if _col is not None:
+            report = observatory.build_report(
+                _col, candidates, best, num_devices,
+                excluded_kinds=excluded).to_dict()
+            if fallbacks:
+                # The cost-minimal proposal(s) that could not be
+                # lowered: the report's winner is the argmin over what
+                # MATERIALIZED, and the skips are on the record.
+                report["materialization_fallbacks"] = fallbacks
+            plan.exploration_report = report
         if not isinstance(plan, PipelineWinner):
             # Winner-only lowering post-check (NOTES_NEXT gap #2): pipeline
             # winners have no single lowered jit to diagnose until

@@ -23,12 +23,14 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 from typing import Any, Callable, Dict, List, Tuple
 
 import jax
 
 from tepdist_tpu.core.mesh import MeshTopology
 from tepdist_tpu.core.service_env import ServiceEnv
+from tepdist_tpu.telemetry import observatory, span
 
 log = logging.getLogger(__name__)
 
@@ -91,7 +93,8 @@ def spmd_candidates(graph, n_devices: int,
             out.append({"kind": "spmd", "topology": topo, "cost": cost,
                         "strategies": strategies})
         except Exception as e:  # noqa: BLE001 — infeasible proposal
-            log.info("spmd proposal %s failed: %s", topo, e)
+            observatory.record_prune("spmd", str(topo),
+                                     "planning_exception", exc=e)
     return out
 
 
@@ -112,9 +115,15 @@ def seq_candidates(graph, n_devices: int,
     out: List[Dict[str, Any]] = []
     for s in (2, 4, 8, 16):
         if s > n_devices or n_devices % s:
+            observatory.record_prune(
+                "seq", f"seq={s}", "enumeration_skip",
+                message=f"seq={s} does not divide {n_devices} devices")
             continue
         d = n_devices // s
         if any(m.seq_len % s for m in motifs) or batch_rows % max(d, 1):
+            observatory.record_prune(
+                "seq", f"seq={s}", "enumeration_skip",
+                message=f"seq_len or batch_rows not divisible at seq={s}")
             continue
         axes = ([("data", d)] if d > 1 else []) + [("seq", s)]
         topo = MeshTopology(axes)
@@ -162,9 +171,11 @@ def seq_candidates(graph, n_devices: int,
                 bubble_ratio=0.0,
                 peak_bytes_per_device=var_bytes + act,
                 memory_feasible=var_bytes + act <= budget)
-            out.append({"kind": "spmd", "topology": topo, "cost": cost})
+            out.append({"kind": "spmd", "topology": topo, "cost": cost,
+                        "enum_kind": "seq"})
         except Exception as e:  # noqa: BLE001 — infeasible proposal
-            log.info("seq proposal seq=%d failed: %s", s, e)
+            observatory.record_prune("seq", str(topo),
+                                     "planning_exception", exc=e)
     return out
 
 
@@ -194,22 +205,37 @@ def pipeline_candidates(loss_fn: Callable, params, example_batch,
         # S up to v * n_devices stays proposable.
         blocked_ok = S <= n_devices and n_devices % S == 0
         if not blocked_ok and (S % 2 or n_devices % (S // 2)):
+            observatory.record_prune(
+                "pipeline", f"S={S}", "enumeration_skip",
+                message=f"S={S} not placeable on {n_devices} devices "
+                        "(blocked or interleaved)")
             continue
         per = n_devices // S if blocked_ok else 0
         for M in (micro_options if micro_options is not None
                   else {num_micro_batches, 2 * num_micro_batches}):
             if batch_rows % M:
+                observatory.record_prune(
+                    "pipeline", f"S={S} M={M}", "enumeration_skip",
+                    message=f"batch_rows={batch_rows} not divisible "
+                            f"by M={M}")
                 continue
             try:
                 prog = plan_pipeline(loss_fn, S, M, params, *example_batch)
             except Exception as e:  # noqa: BLE001
-                log.info("pipeline proposal S=%d M=%d failed: %s", S, M, e)
+                observatory.record_prune(
+                    "pipeline", f"S={S} M={M}", "planning_exception",
+                    exc=e)
                 continue
             stage_devs = ([tuple(range(s * per, (s + 1) * per))
                            for s in range(S)] if blocked_ok else None)
             stage_graphs = None
             for tp in ((1, 2, 4, 8) if blocked_ok else ()):
                 if tp > per or per % tp:
+                    observatory.record_prune(
+                        "pipeline", f"S={S} M={M} tp={tp}",
+                        "enumeration_skip",
+                        message=f"tp={tp} does not fit the {per} "
+                                "devices per stage")
                     continue
                 try:
                     dag, _ = build_pipeline_task_dag(prog, stage_devs)
@@ -235,8 +261,9 @@ def pipeline_candidates(loss_fn: Callable, params, example_batch,
                          "num_micro_batches": M, "intra_tp": tp,
                          "placement": "blocked", "cost": cost})
                 except Exception as e:  # noqa: BLE001
-                    log.info("pipeline proposal S=%d M=%d tp=%d failed: %s",
-                             S, M, tp, e)
+                    observatory.record_prune(
+                        "pipeline", f"S={S} M={M} tp={tp}",
+                        "planning_exception", exc=e)
             # Interleaved variants (Megatron virtual stages, reference:
             # the stage ordinal placed round-robin): the SAME S-stage cut
             # over G = S/v device groups, stage s -> group s % G. The
@@ -244,9 +271,19 @@ def pipeline_candidates(loss_fn: Callable, params, example_batch,
             # chunk-alternating schedule (task_scheduler._ranks).
             for v in (2,):
                 if S % v or S // v < 2:
+                    observatory.record_prune(
+                        "pipeline", f"S={S} M={M} il/v={v}",
+                        "enumeration_skip",
+                        message=f"S={S} yields fewer than 2 virtual "
+                                f"groups at v={v}")
                     continue
                 G = S // v
                 if n_devices % G:
+                    observatory.record_prune(
+                        "pipeline", f"S={S} M={M} il/G={G}",
+                        "enumeration_skip",
+                        message=f"{G} groups do not divide "
+                                f"{n_devices} devices")
                     continue
                 per_g = n_devices // G
                 groups = [tuple(range(g * per_g, (g + 1) * per_g))
@@ -262,8 +299,9 @@ def pipeline_candidates(loss_fn: Callable, params, example_batch,
                          "placement": "interleaved",
                          "interleave_groups": G, "cost": cost})
                 except Exception as e:  # noqa: BLE001
-                    log.info("interleaved proposal S=%d/G=%d M=%d "
-                             "failed: %s", S, G, M, e)
+                    observatory.record_prune(
+                        "pipeline", f"S={S} M={M} il/G={G}",
+                        "planning_exception", exc=e)
     return out
 
 
@@ -308,6 +346,7 @@ def explore(
     include_seq: bool = True,
     pipeline_loss_fn: Callable = None,
     pipeline_micro_options=None,
+    entry_point: str = "explore",
 ) -> Dict[str, Any]:
     """Full exploration over the unified candidate space (reference:
     RunExplorationlMode over DeviceSplitPlan proposals incl. pipeline
@@ -318,37 +357,72 @@ def explore(
     ``include_pipeline=False`` / ``include_seq=False`` restrict the space
     (the service uses these when the client shipped no optimizer spec and
     a pipeline/seq winner could not be materialized server-side — the
-    restriction is RECORDED in the result, never silent)."""
+    restriction is RECORDED in the result, never silent).
+
+    The whole search runs under an observatory capture: every enumerated
+    proposal lands in the winner's ``best["report"]``
+    (``telemetry/observatory.ExplorationReport``) as a priced candidate
+    or a typed prune record, with phase timings and the winner's
+    rationale — rendered by tools/plan_explain.py."""
     from tepdist_tpu.graph.jaxpr_graph import trace_graph
 
-    grad_fn = jax.value_and_grad(loss_fn)
-    graph, _, _ = trace_graph(grad_fn, params, *example_batch)
-    batch0 = jax.tree_util.tree_leaves(example_batch)[0]
-    batch_rows = batch0.shape[0]
+    with observatory.capture(entry_point) as col:
+        t0 = time.perf_counter()
+        with span("explore:trace", cat="planner"):
+            grad_fn = jax.value_and_grad(loss_fn)
+            graph, _, _ = trace_graph(grad_fn, params, *example_batch)
+        batch0 = jax.tree_util.tree_leaves(example_batch)[0]
+        batch_rows = batch0.shape[0]
+        if col is not None:
+            col.phase("trace", time.perf_counter() - t0)
 
-    candidates = spmd_candidates(graph, n_devices)
-    excluded: List[str] = []
-    if include_seq:
-        candidates += seq_candidates(graph, n_devices, batch_rows)
-    else:
-        excluded.append("seq")
-    if include_pipeline:
-        candidates += pipeline_candidates(
-            pipeline_loss_fn or loss_fn, params, example_batch, n_devices,
-            batch_rows, num_micro_batches,
-            micro_options=pipeline_micro_options)
-    else:
-        excluded.append("pipeline")
-    if not candidates:
-        raise RuntimeError("no feasible parallelism proposal")
-    best = min(candidates, key=lambda c: c["cost"].key())
-    log.info("exploration winner: %s (duration %.3e s/step) of %d proposals",
-             best["kind"], best["cost"].total_duration, len(candidates))
-    if ServiceEnv.get().debug:
-        _dump_candidate_table(candidates, best)
-    best["candidates"] = candidates
-    if excluded:
-        best["excluded_kinds"] = excluded
+        t0 = time.perf_counter()
+        with span("explore:spmd", cat="planner", n_devices=n_devices):
+            candidates = spmd_candidates(graph, n_devices)
+        if col is not None:
+            col.phase("spmd", time.perf_counter() - t0)
+        excluded: List[str] = []
+        if include_seq:
+            t0 = time.perf_counter()
+            with span("explore:seq", cat="planner"):
+                candidates += seq_candidates(graph, n_devices, batch_rows)
+            if col is not None:
+                col.phase("seq", time.perf_counter() - t0)
+        else:
+            excluded.append("seq")
+        if include_pipeline:
+            t0 = time.perf_counter()
+            with span("explore:pipeline", cat="planner"):
+                candidates += pipeline_candidates(
+                    pipeline_loss_fn or loss_fn, params, example_batch,
+                    n_devices, batch_rows, num_micro_batches,
+                    micro_options=pipeline_micro_options)
+            if col is not None:
+                col.phase("pipeline", time.perf_counter() - t0)
+        else:
+            excluded.append("pipeline")
+        if not candidates:
+            if col is not None:
+                report = observatory.build_report(
+                    col, [], None, n_devices, entry_point=entry_point,
+                    excluded_kinds=excluded)
+                for w in report.warnings:
+                    log.warning("exploration: %s", w)
+            raise RuntimeError("no feasible parallelism proposal")
+        best = min(candidates, key=lambda c: c["cost"].key())
+        log.info("exploration winner: %s (duration %.3e s/step) of %d "
+                 "proposals", best["kind"], best["cost"].total_duration,
+                 len(candidates))
+        if ServiceEnv.get().debug:
+            _dump_candidate_table(candidates, best)
+        best["candidates"] = candidates
+        if excluded:
+            best["excluded_kinds"] = excluded
+        if col is not None:
+            report = observatory.build_report(
+                col, candidates, best, n_devices,
+                excluded_kinds=excluded)
+            best["report"] = report.to_dict()
     return best
 
 
@@ -377,6 +451,10 @@ def winner_lowering_postcheck(plan, devices=None) -> List[str]:
         # The winner's candidate dict shares its Cost object with the plan.
         if c.get("cost") is getattr(plan, "cost", None):
             c["involuntary_remats"] = list(remats)
+    # Fold the verdict into the decision record (the postcheck runs
+    # after explore() returned, so the report already exists).
+    observatory.fold_remats(getattr(plan, "exploration_report", None),
+                            remats)
     if remats:
         metrics().counter("involuntary_remat").inc(len(remats))
         log.warning(

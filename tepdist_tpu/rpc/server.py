@@ -435,11 +435,16 @@ class TepdistServicer:
             include_seq=(optimizer is not None
                          and micro_loss_fn is not None),
             pipeline_loss_fn=micro_loss_fn,
-            pipeline_micro_options=[M])
+            pipeline_micro_options=[M],
+            entry_point="BuildExecutionPlan")
         explored = {
             "winner": best["kind"],
             "candidates": candidate_summary(best["candidates"], best),
         }
+        if "report" in best:
+            # The full decision record rides the explore RPC (plain JSON
+            # header payload) — the client embeds it in dump_trace().
+            explored["report"] = best["report"]
         if best.get("excluded_kinds"):
             explored["excluded_kinds"] = best["excluded_kinds"]
             explored["excluded_reason"] = (
@@ -712,6 +717,9 @@ class TepdistServicer:
             except Exception as e:  # noqa: BLE001 — diagnostics only
                 log.warning("lowering post-check failed: %r", e)
             else:
+                from tepdist_tpu.telemetry import observatory
+                observatory.fold_remats(explored.get("report"),
+                                        explored["lowering_remats"])
                 n_remats = len(explored["lowering_remats"])
                 if n_remats:
                     metrics().counter("involuntary_remat").inc(n_remats)

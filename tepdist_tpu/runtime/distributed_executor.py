@@ -688,15 +688,22 @@ class DistributedPipelineSession:
         from tepdist_tpu.telemetry import dump_merged_trace
         live = [c for ti, c in sorted(self.clients.items())
                 if ti not in self.health.dead]
-        extra = None
+        extra = {}
         if include_predicted:
-            extra = {"fidelity": {
+            extra["fidelity"] = {
                 "predicted": self.schedule.predicted_timeline(self.dag),
                 "makespan_ms": self.schedule.makespan * 1e3,
                 "policy": self.schedule.policy,
-            }}
+            }
+        # When the program came out of exploration, the decision record
+        # (telemetry/observatory.py) rides next to the fidelity payload:
+        # one trace file feeds both plan_explain and fidelity_report.
+        report = getattr(self, "exploration_report", None)
+        if report:
+            extra["exploration"] = report
         return dump_merged_trace(live, path=path, name="trace",
-                                 clear=clear, extra_metadata=extra)
+                                 clear=clear,
+                                 extra_metadata=extra or None)
 
     @classmethod
     def resume(cls, prog, cluster, params_template, optimizer=None,

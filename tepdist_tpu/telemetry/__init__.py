@@ -40,3 +40,4 @@ from tepdist_tpu.telemetry import calibrate  # noqa: F401
 from tepdist_tpu.telemetry import fidelity  # noqa: F401
 from tepdist_tpu.telemetry import flight  # noqa: F401
 from tepdist_tpu.telemetry import ledger  # noqa: F401
+from tepdist_tpu.telemetry import observatory  # noqa: F401

@@ -160,6 +160,7 @@ def explore_parallelism(
     *example_batch,
     n_devices: int,
     num_micro_batches: int = 4,
+    entry_point: str = "explore_parallelism",
 ) -> Dict[str, Any]:
     """Full exploration over the UNIFIED candidate space — SPMD mesh
     factorizations, seq-parallel meshes, and pipeline stage cuts
@@ -169,7 +170,8 @@ def explore_parallelism(
     from tepdist_tpu.parallel.exploration import explore
 
     return explore(loss_fn, params, *example_batch, n_devices=n_devices,
-                   num_micro_batches=num_micro_batches)
+                   num_micro_batches=num_micro_batches,
+                   entry_point=entry_point)
 
 
 def plan_training(
@@ -211,7 +213,8 @@ def plan_training(
     if explore and topology is None and num_stages is None:
         best = explore_parallelism(
             loss_fn, params, *example_batch, n_devices=len(devices),
-            num_micro_batches=num_micro_batches or 4)
+            num_micro_batches=num_micro_batches or 4,
+            entry_point="plan_training")
         explored_winner = best
         if best["kind"] == "pipeline":
             num_stages = best["num_stages"]
@@ -292,7 +295,10 @@ def plan_training(
                                  stage_var_mem_limit=var_mem_limit,
                                  placement=placement,
                                  interleave_groups=interleave_groups)
-        return _PipelineTrainingPlan(exe, params)
+        tplan = _PipelineTrainingPlan(exe, params)
+        if explored_winner is not None and "report" in explored_winner:
+            tplan.exploration_report = explored_winner["report"]
+        return tplan
 
     # ---- SPMD (+ GA) path ---------------------------------------------
     from tepdist_tpu.graph.jaxpr_graph import trace_graph
@@ -351,6 +357,8 @@ def plan_training(
         except Exception as e:  # noqa: BLE001 — diagnostics only
             log.warning("lowering post-check failed: %r", e)
         else:
+            from tepdist_tpu.telemetry import observatory
+            observatory.fold_remats(explored_winner.get("report"), remats)
             if remats:
                 metrics().counter("involuntary_remat").inc(len(remats))
                 log.warning(
@@ -362,5 +370,8 @@ def plan_training(
                     list(topology.device_axes()), len(remats),
                     ", ".join(remats[:3]))
     n_batch_leaves = len(jax.tree_util.tree_leaves(example_batch))
-    return _SpmdTrainingPlan(plan, params, opt_state, n_batch_leaves,
-                             devices)
+    tplan = _SpmdTrainingPlan(plan, params, opt_state, n_batch_leaves,
+                              devices)
+    if explored_winner is not None and "report" in explored_winner:
+        tplan.exploration_report = explored_winner["report"]
+    return tplan

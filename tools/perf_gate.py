@@ -51,7 +51,8 @@ DEFAULT_HISTORY = os.path.join(HERE, "bench_history.jsonl")
 # watchlist; recording always keeps everything.
 DEFAULT_KEYS = ("two_worker_fleet_ms", "serving_tok_s",
                 "paged_capacity_x", "plan_verify_ms",
-                "rpc_orchestration_ms", "serde_ms")
+                "rpc_orchestration_ms", "serde_ms",
+                "explore_report_ms")
 
 _HIGHER_BETTER_SUFFIXES = ("tok_s", "_x", "_per_s", "_rate", "_speedup")
 _PROMOTE_SUFFIXES = ("_ms", "_us", "_x", "_pct", "tok_s", "_per_s",
@@ -213,6 +214,11 @@ def main(argv=None) -> int:
     ap.add_argument("--seed-regression", default=None, metavar="KEY:PCT",
                     help="perturb KEY by PCT in the bad direction before "
                          "checking (gate self-test)")
+    ap.add_argument("--plan-diff", default=None, metavar="OLD,NEW",
+                    help="two ExplorationReport JSONs: an exploration "
+                         "winner FLIP between them fails --check unless "
+                         "some gated key measurably improved (a plan "
+                         "change must pay for itself on the bench)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -273,8 +279,38 @@ def main(argv=None) -> int:
     rows = check_values(values, prior, keys=keys, k=args.k,
                         band_pct=args.band_pct)
     bad = [r for r in rows if r["verdict"] == "regression"]
+
+    # --plan-diff: an exploration winner flip is only acceptable when
+    # it bought a measurable bench improvement — otherwise the plan
+    # change is an unexplained behavior change and the gate trips
+    # (tools/plan_diff.py names the driving cost term).
+    plan_flip = None
+    if args.plan_diff:
+        from tools.plan_diff import load_report
+        from tepdist_tpu.telemetry import observatory
+        old_p, _, new_p = args.plan_diff.partition(",")
+        d = observatory.diff_reports(load_report(old_p.strip()),
+                                     load_report(new_p.strip()))
+        if d.get("flip"):
+            improved = [r for r in rows if r["verdict"] == "improved"]
+            plan_flip = {
+                "old_winner": d.get("old_winner"),
+                "new_winner": d.get("new_winner"),
+                "driver": d.get("driver"),
+                "bench_improved": [r["key"] for r in improved],
+                "ok": bool(improved),
+            }
+            if not improved:
+                bad.append({"key": "plan_winner_flip",
+                            "verdict": "regression",
+                            "current": None, "higher_better": False,
+                            "detail": d.get("detail")})
+
     if args.json:
-        print(json.dumps({"rows": rows, "ok": not bad}, indent=1))
+        out = {"rows": rows, "ok": not bad}
+        if plan_flip is not None:
+            out["plan_flip"] = plan_flip
+        print(json.dumps(out, indent=1))
     else:
         for r in rows:
             cur = "-" if r["current"] is None else f"{r['current']:.3f}"
@@ -284,6 +320,14 @@ def main(argv=None) -> int:
             arrow = "^" if r["higher_better"] else "v"
             print(f"  {r['key']:<28} {cur:>12} vs {base:<34} "
                   f"[{arrow}] {r['verdict']}")
+        if plan_flip is not None:
+            verdict = ("covered by bench improvement on "
+                       + ", ".join(plan_flip["bench_improved"])
+                       if plan_flip["ok"] else
+                       "NO bench improvement — unexplained plan change")
+            print(f"  plan flip {plan_flip['old_winner']} -> "
+                  f"{plan_flip['new_winner']} "
+                  f"(driver: {plan_flip['driver']}): {verdict}")
         print("perf gate: " + ("FAILED on " +
                                ", ".join(r["key"] for r in bad)
                                if bad else "OK"))

@@ -116,6 +116,47 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
     fl = _flight_section(trace)
     if fl is not None:
         out["flight"] = fl
+    ex = _exploration_section(trace)
+    if ex is not None:
+        out["exploration"] = ex
+    return out
+
+
+def _exploration_section(trace: Dict[str, Any]) -> Any:
+    """Planner decision-record digest when the trace embeds an
+    ExplorationReport (metadata.exploration, session.dump_trace):
+    candidate count by kind, prune histogram by reason, winner +
+    runner-up delta, and scoreboard drift against the fidelity
+    attribution when that metadata is present too."""
+    report = (trace.get("metadata") or {}).get("exploration")
+    if not report:
+        return None
+    try:
+        from tepdist_tpu.telemetry import fidelity, observatory
+    except ImportError:
+        return {"error": "tepdist_tpu not importable"}
+    counts = report.get("counts") or {}
+    winner = report.get("winner") or {}
+    rationale = report.get("rationale") or {}
+    out = {
+        "entry_point": report.get("entry_point"),
+        "candidates_by_kind": counts.get("candidates_by_kind"),
+        "prune_histogram": report.get("prune_histogram"),
+        "winner": (f"{winner.get('kind')}:{winner.get('config')}"
+                   if winner else None),
+        "runner_up_delta_s": rationale.get("delta_s"),
+        "deciding_term": rationale.get("deciding_term"),
+        "warnings": report.get("warnings") or [],
+        "completeness": observatory.completeness(report),
+    }
+    if report.get("lowering_remats"):
+        out["lowering_remats"] = len(report["lowering_remats"])
+    fid = fidelity.report_from_trace(trace)
+    if fid is not None:
+        sb = observatory.scoreboard(report, fid)
+        if sb.get("ok"):
+            out["scoreboard_drift"] = {
+                t: row["drift_ms"] for t, row in sb["terms"].items()}
     return out
 
 
@@ -323,6 +364,32 @@ def main() -> None:
                   f"transfer={a['transfer_ms']} "
                   f"serde={a['host_serde_ms']} idle={a['idle_ms']} "
                   f"(window {a['window_ms']} ms)")
+    ex = s.get("exploration")
+    if ex and not ex.get("error"):
+        print(f"exploration (entry_point={ex['entry_point']}; full "
+              "report: tools/plan_explain.py):")
+        print(f"  candidates by kind: {ex['candidates_by_kind']}  "
+              f"prunes: {ex['prune_histogram'] or '{}'}")
+        delta = (f" (beats runner-up by {ex['runner_up_delta_s']:.3e}s, "
+                 f"deciding term: {ex['deciding_term']})"
+                 if ex.get("runner_up_delta_s") is not None else
+                 f" (deciding term: {ex['deciding_term']})"
+                 if ex.get("deciding_term") else "")
+        print(f"  winner: {ex['winner']}{delta}")
+        if ex.get("lowering_remats"):
+            print(f"  lowering post-check: {ex['lowering_remats']} "
+                  "involuntary remat(s)")
+        comp = ex.get("completeness") or {}
+        if not comp.get("ok", True):
+            print(f"  LEDGER INCOMPLETE: {comp.get('problems')}")
+        if ex.get("scoreboard_drift"):
+            drifts = "  ".join(f"{t}={v:+.3f}" if v is not None
+                               else f"{t}=-"
+                               for t, v in ex["scoreboard_drift"].items())
+            print(f"  scoreboard drift (measured-predicted, ms): "
+                  f"{drifts}")
+        for w in ex.get("warnings") or []:
+            print(f"  WARNING: {w}")
     led = s.get("ledger")
     if led and not led.get("error"):
         print("rpc ledger (per verb):")
