@@ -865,6 +865,127 @@ def bench_paged_capacity() -> dict:
     }
 
 
+def bench_quantized_ar() -> dict:
+    """Fidelity-vs-int8 gradient AllReduce A/B over the SAME tensor set:
+    every gradient-shaped leaf is encoded through the real wire path
+    (rpc/protocol.encode_literal) once at fidelity f32 and once as
+    chunk-scale int8, then decoded back. The reported value is wire
+    bytes fidelity/int8 — deterministic (bytes, not timings), the
+    bandwidth term the evaluator's compressed_all_reduce_cost scales by.
+    Encode+decode wall time rides along as sub-keys (the quantize
+    compute its quantize_overhead term models); round-trip error is
+    reported so the lossy arm's numerics stay visible."""
+    import numpy as np
+
+    from tepdist_tpu.rpc import protocol
+
+    rng = np.random.default_rng(0)
+    shapes = [(256, 256), (256,), (1024, 64), (64,), (4, 256, 32)]
+    grads = [rng.standard_normal(s).astype(np.float32) * 0.02
+             for s in shapes]
+
+    def arm(wd):
+        total, err = 0, 0.0
+        t0 = time.perf_counter()
+        for g in grads:
+            meta, blob = protocol.encode_literal(g, wire_dtype=wd)
+            total += memoryview(blob).nbytes
+            out = protocol.decode_literal(meta, blob)
+            err = max(err, float(np.max(np.abs(out - g))))
+        return total, (time.perf_counter() - t0) * 1e3, err
+
+    fid_bytes, fid_ms, fid_err = arm(None)
+    q_bytes, q_ms, q_err = arm("int8")
+    ratio = fid_bytes / q_bytes if q_bytes else None
+    return {
+        "metric": "quantized_ar_x",
+        "value": round(ratio, 3) if ratio else None,
+        "unit": "x wire bytes vs fidelity f32 (same gradient tensors)",
+        "fidelity_bytes": fid_bytes,
+        "int8_bytes": q_bytes,
+        "fidelity_roundtrip_err": fid_err,   # must be exactly 0.0
+        "int8_roundtrip_err": round(q_err, 6),
+        "encode_fidelity_wall_ms": round(fid_ms, 2),
+        "encode_int8_wall_ms": round(q_ms, 2),
+        "gate_1p5x": bool(ratio and ratio >= 1.5),
+    }
+
+
+def bench_host_push_bytes(steps: int = 4) -> dict:
+    """Fleet activation-wire bytes per training step on the two-worker
+    in-proc pipeline fixture, read from the ledger's byte-exact tx_blob
+    accounting (telemetry/ledger.py): one session per wire mode — the
+    wire dtype latches at session/worker construction — with the compile
+    step excluded. value = fidelity bytes/step (lower is better, so
+    payload bloat trips the gate); ``host_push_compression_x`` =
+    fidelity/int8 rides along under the gate's higher-is-better watch."""
+    import optax
+
+    from tepdist_tpu.core.service_env import ServiceEnv
+    from tepdist_tpu.parallel.pipeline import plan_pipeline
+    from tepdist_tpu.rpc.inproc import (close_inproc_cluster,
+                                        make_inproc_cluster)
+    from tepdist_tpu.runtime.distributed_executor import (
+        DistributedPipelineSession,
+    )
+    from tepdist_tpu.telemetry import ledger
+
+    def loss_fn(params, x, y):
+        h = x
+        for i in range(4):
+            h = jnp.tanh(h @ params[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    keys = jax.random.split(jax.random.PRNGKey(0), 6)
+    params = {f"w{i}": jax.random.normal(keys[i], (16, 16)) * 0.3
+              for i in range(4)}
+    x = jax.random.normal(keys[4], (8, 16))
+    y = jax.random.normal(keys[5], (8, 16))
+
+    env = ServiceEnv.get()
+    prev_wd = env.tepdist_wire_dtype
+    prev_led = ledger.enabled()
+
+    def bytes_per_step(wd: str) -> float:
+        env.set("TEPDIST_WIRE_DTYPE", wd)
+        led = ledger.configure(enabled=True)
+        prog = plan_pipeline(loss_fn, 2, 2, params, x, y)
+        cluster, _serv = make_inproc_cluster(2, jax.devices()[:1])
+        sess = DistributedPipelineSession(prog, cluster,
+                                          optimizer=optax.sgd(1e-2))
+        try:
+            sess.load_variables(params)
+            sess.step(x, y)          # compile + first-dispatch envelopes
+            led.clear()
+            for _ in range(steps):
+                sess.step(x, y)
+            snap = led.snapshot(clear=True)
+        finally:
+            sess.close()
+            close_inproc_cluster(cluster)
+        total = sum(s.get("tx_blob_bytes", 0.0)
+                    for s in snap["verbs"].values())
+        return total / steps
+
+    try:
+        fid = bytes_per_step("")
+        bf16 = bytes_per_step("bfloat16")
+        q8 = bytes_per_step("int8")
+    finally:
+        env.set("TEPDIST_WIRE_DTYPE", prev_wd)
+        ledger.configure(enabled=prev_led)
+    return {
+        "metric": "host_push_bytes_per_step",
+        "value": round(fid, 1),
+        "unit": "tx blob bytes/step, 2-worker in-proc fleet "
+                "(fidelity wire)",
+        "bf16_bytes_per_step": round(bf16, 1),
+        "int8_bytes_per_step": round(q8, 1),
+        "host_push_compression_x": round(fid / q8, 3) if q8 else None,
+        "steps": steps,
+    }
+
+
 def _persist_tpu_headline(line: dict) -> None:
     """Record the last-good TPU headline with provenance so a future
     tunnel wedge degrades to a STALE-FLAGGED TPU number, never a CPU
@@ -1001,6 +1122,16 @@ def main() -> None:
         except Exception:
             extra.append({"metric": "explore_report_ms", "error":
                           traceback.format_exc(limit=3).splitlines()[-1]})
+        try:
+            extra.append(bench_quantized_ar())
+        except Exception:
+            extra.append({"metric": "quantized_ar_x", "error":
+                          traceback.format_exc(limit=3).splitlines()[-1]})
+        try:
+            extra.append(bench_host_push_bytes())
+        except Exception:
+            extra.append({"metric": "host_push_bytes_per_step", "error":
+                          traceback.format_exc(limit=3).splitlines()[-1]})
         # Carry forward the last TPU round's secondary lines STALE-FLAGGED
         # (mirroring the headline policy) instead of silently dropping
         # them: the fresh runtime line replaces only its own metric.
@@ -1066,6 +1197,8 @@ def main() -> None:
         "trace": bench_trace_overhead,   # ~ms; telemetry no-op guarantee
         "ledger": bench_ledger_overhead,  # RPC ledger+flight hook cost
         "explore": bench_explore_report,  # observatory capture cost
+        "qar": bench_quantized_ar,        # fidelity-vs-int8 AR wire bytes
+        "hostpush": bench_host_push_bytes,  # fleet activation wire bytes
         "serving": bench_serving,        # continuous-batching decode tok/s
         "paged": bench_paged_capacity,   # paged-vs-slots admission capacity
         "117m": lambda: bench_gpt2_117m(True),

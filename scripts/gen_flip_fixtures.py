@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Regenerate the committed comm-dtype winner-flip fixtures.
+
+Runs the full exploration twice over the GPT-2 ``test`` config graph —
+once at healthy interconnect bandwidth (the fidelity mesh wins) and once
+at starved bandwidth (the int8-compressed data-parallel mesh wins) — and
+writes the observatory ExplorationReports to ``tests/fixtures/``:
+
+    coll_flip_before.json   ICI 400 GB/s  -> fidelity winner
+    coll_flip_after.json    ICI 5 MB/s    -> @int8 winner, driver coll_s
+
+``tools/plan_diff.py before after --expect-flip coll_s`` must pass on
+the pair; scripts/quant_smoke.sh and tests/test_comm_dtype.py assert it.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+from tepdist_tpu.core.service_env import ServiceEnv
+from tepdist_tpu.models import gpt2
+from tepdist_tpu.parallel.exploration import explore
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "tests", "fixtures")
+
+
+def report(ici_gbps: float):
+    try:
+        ServiceEnv.reset({"ICI_BANDWIDTH": ici_gbps})
+        cfg = gpt2.CONFIGS["test"]
+        params = jax.eval_shape(
+            lambda k: gpt2.init_params(cfg, k), jax.random.PRNGKey(0))
+        toks = jax.ShapeDtypeStruct((8, 33), jnp.int32)
+
+        def loss(p, t):
+            return gpt2.loss_fn(p, t, cfg)
+
+        best = explore(loss, params, toks, n_devices=8,
+                       num_micro_batches=2, include_pipeline=False,
+                       include_seq=False)
+        print(f"ICI {ici_gbps}: winner kind={best.get('kind')} "
+              f"config={best.get('config')!r} "
+              f"comm_dtype={best.get('comm_dtype', '')!r}")
+        return best["report"]
+    finally:
+        ServiceEnv.reset()
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    for name, rep in (("coll_flip_before.json", report(400.0)),
+                      ("coll_flip_after.json", report(0.005))):
+        path = os.path.join(OUT, name)
+        with open(path, "w") as f:
+            json.dump(rep, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {path} ({os.path.getsize(path)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

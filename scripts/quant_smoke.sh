@@ -1,0 +1,96 @@
+#!/usr/bin/env bash
+# Comm-dtype compression smoke (ISSUE 13): prove the compressed-
+# collective planner candidates + compressed wire end to end.
+#
+#   1. FLIP FIXTURE: the committed before/after ExplorationReports
+#      (scripts/gen_flip_fixtures.py — GPT-2 graph at healthy vs starved
+#      ICI bandwidth) MUST flip the winner to an @int8 mesh with coll_s
+#      as the named driver (plan_diff --check fails, --expect-flip
+#      passes).
+#   2. LEDGER: tools/plan_explain.py --fixture --check still accounts
+#      every proposal with compressed variants in the candidate space.
+#   3. NUMERICS: fidelity comm_dtype is bit-identical; bf16/int8
+#      gradient AR tracks the fidelity loss trajectory within the band.
+#   4. WIRE: bench_quantized_ar's byte ratio clears the 1.5x gate.
+#   5. PERF GATE: the ratio records as a trend; a winner flip passes
+#      --plan-diff only when a gated key measurably improved; a seeded
+#      20% regression on quantized_ar_x MUST trip the gate.
+#
+# Override the per-pass bound with QUANT_SMOKE_TIMEOUT (seconds).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${QUANT_SMOKE_TIMEOUT:-600}"
+TMPDIR_SMOKE="$(mktemp -d)"
+trap 'rm -rf "$TMPDIR_SMOKE"' EXIT
+export JAX_PLATFORMS=cpu
+
+BEFORE="tests/fixtures/coll_flip_before.json"
+AFTER="tests/fixtures/coll_flip_after.json"
+
+echo "=== quant smoke 1/5: committed winner-flip fixtures (driver coll_s) ==="
+if timeout -k 10 "$TIMEOUT" python tools/plan_diff.py \
+    "$BEFORE" "$AFTER" --check > /dev/null 2>&1; then
+    echo "quant smoke: FAIL (fixture flip did not fail plan_diff --check)"
+    exit 1
+fi
+timeout -k 10 "$TIMEOUT" python tools/plan_diff.py \
+    "$BEFORE" "$AFTER" --expect-flip | tee "$TMPDIR_SMOKE/flip.txt"
+grep -q "driver: coll_s" "$TMPDIR_SMOKE/flip.txt" || {
+    echo "quant smoke: FAIL (flip driver is not coll_s)"; exit 1; }
+grep -q "@int8" "$TMPDIR_SMOKE/flip.txt" || {
+    echo "quant smoke: FAIL (new winner is not a compressed candidate)"
+    exit 1; }
+
+echo "=== quant smoke 2/5: candidate ledger + scoreboard (plan_explain) ==="
+timeout -k 10 "$TIMEOUT" python tools/plan_explain.py --fixture --check
+
+echo "=== quant smoke 3/5: compressed-gradient numerics ==="
+timeout -k 10 "$TIMEOUT" python -m pytest tests/test_comm_dtype.py -q \
+    -p no:cacheprovider -k "bit_identical or loss_band or roundtrip"
+
+echo "=== quant smoke 4/5: quantized AR wire ratio ==="
+QAR="$(timeout -k 10 "$TIMEOUT" python - <<'PY'
+import bench
+r = bench.bench_quantized_ar()
+assert r["gate_1p5x"], f"quantized_ar_x below 1.5x: {r}"
+assert r["fidelity_roundtrip_err"] == 0.0, r
+print(f"{r['value']:.3f}")
+PY
+)"
+echo "quantized_ar_x = $QAR (gate: >= 1.5)"
+
+echo "=== quant smoke 5/5: perf gate — flip coverage + seeded regression ==="
+HIST_IMP="$TMPDIR_SMOKE/hist_improved.jsonl"
+HIST_REG="$TMPDIR_SMOKE/hist_flat.jsonl"
+BASE="$(python -c "print(float('$QAR') / 2)")"
+for i in 1 2 3; do
+    timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST_IMP" \
+        --record-value "quantized_ar_x=$BASE" > /dev/null
+    timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST_REG" \
+        --record-value "quantized_ar_x=$QAR" > /dev/null
+done
+# The flip is covered: quantized_ar_x improved vs the pre-compression
+# baseline, so the plan change pays for itself and the gate passes.
+timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST_IMP" \
+    --check --keys quantized_ar_x \
+    --record-value "quantized_ar_x=$QAR" \
+    --plan-diff "$BEFORE,$AFTER"
+# The same flip with NO bench improvement is an unexplained plan change.
+if timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST_REG" \
+    --check --keys quantized_ar_x \
+    --record-value "quantized_ar_x=$QAR" \
+    --plan-diff "$BEFORE,$AFTER" > /dev/null 2>&1; then
+    echo "quant smoke: FAIL (uncovered winner flip did not trip the gate)"
+    exit 1
+fi
+# A seeded 20% regression on the ratio MUST trip the gate.
+if timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST_REG" \
+    --check --keys quantized_ar_x \
+    --record-value "quantized_ar_x=$QAR" \
+    --seed-regression quantized_ar_x:20; then
+    echo "quant smoke: FAIL (seeded 20% regression did not trip the gate)"
+    exit 1
+fi
+
+echo "quant smoke: PASS"

@@ -99,12 +99,15 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
      "activation serde + the peer RPC with the tail of compute (async "
      "send pool, joined at step end); 0 = synchronous sends inside the "
      "task loop"),
-    ("TEPDIST_WIRE_DTYPE", str, "", "opt-in wire dtype for host-push "
-     "activation payloads (e.g. 'bfloat16'): f32/f64 tensors are "
-     "down-cast on the wire and restored to their source dtype on "
-     "arrival — halves tx_blob bytes at reduced mantissa (EQuARX-style "
-     "lossy wire compression, arXiv:2506.17615); default '' keeps the "
-     "wire bit-identical"),
+    ("TEPDIST_WIRE_DTYPE", str, "", "opt-in wire dtype for fleet tensor "
+     "payloads — worker host-push activations AND master dispatch "
+     "envelopes. 'bfloat16'/'float16': f32/f64 tensors are down-cast on "
+     "the wire and restored to their source dtype on arrival (halves "
+     "tx_blob bytes at reduced mantissa); 'int8': shape-aware chunk-scale "
+     "quantization (parallel/quantize.py, ~26% of the f32 payload; "
+     "EQuARX-style, arXiv:2506.17615). Integer payloads are never cast. "
+     "Default '' defers to the exploration winner's comm_dtype (plan_meta)"
+     " and otherwise keeps the wire bit-identical"),
     ("TEPDIST_HEAVY_RPC_SLOTS", int, 0, "bounded async server executor: "
      "max concurrently RUNNING heavy handlers (ExecuteStepSlice/"
      "ExecuteRemotePlan/ExecutePlan/BuildExecutionPlan/LoadServable) per "

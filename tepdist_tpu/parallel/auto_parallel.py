@@ -45,6 +45,11 @@ class ParallelPlan:
     in_tree: Any
     out_tree: Any
     mode: str
+    # The exploration winner's comm-dtype modifier (""/"float32" =
+    # fidelity; "bfloat16"/"int8" = compressed gradient collectives).
+    # Consumed by train.plan_training when it rebuilds the GA step and by
+    # the RPC dispatch plumbing; the plan's OWN jit is dtype-agnostic.
+    comm_dtype: str = ""
 
     _flat_cache: Any = None     # donate tuple -> jitted flat step fn
     _mesh: Any = None
@@ -557,7 +562,8 @@ def _materialize_explored(best, fn, graph, in_tree, out_tree, example_args,
             cost=best["cost"], candidates=candidates,
             loss_fn=fn, params=params, example_batch=tuple(batch),
             placement=best.get("placement", "blocked"),
-            interleave_groups=best.get("interleave_groups"))
+            interleave_groups=best.get("interleave_groups"),
+            comm_dtype=best.get("comm_dtype", ""))
 
     topo = best["topology"]
     is_seq = any(n == "seq" and s > 1 for n, s in topo.device_axes())
@@ -588,6 +594,7 @@ def _materialize_explored(best, fn, graph, in_tree, out_tree, example_args,
         graph=graph, topology=topo, strategies=strategies,
         sharding_plan=sharding_plan, in_tree=in_tree, out_tree=out_tree,
         mode="exploration",
+        comm_dtype=best.get("comm_dtype", ""),
     )
     plan.cost = best["cost"]
     plan.candidates = candidates

@@ -72,10 +72,16 @@ class Cost:
 
 class Evaluator:
     def __init__(self, topology: MeshTopology, chip=None,
-                 usage_ratio: float = 0.9):
+                 usage_ratio: float = 0.9, comm_dtype: str = ""):
+        """``comm_dtype``: price gradient collectives at a compressed wire
+        dtype (""/"float32" = fidelity, "bfloat16", "int8"). Only the
+        partial-resolution psums (gradient AllReduce) compress — reshard
+        edges and hidden gathers move activations/params whose consumers
+        need full precision, so they stay at fidelity bytes."""
         self.topology = topology
         self.spec = chip or chip_spec()
         self.usage_ratio = usage_ratio
+        self.comm_dtype = comm_dtype
 
     # -- SPMD ------------------------------------------------------------
     def _reshard_time(self, graph: JaxprGraph, gs: GraphStrategy,
@@ -221,8 +227,9 @@ class Evaluator:
                             resolved = True
                             break
                 if resolved:
-                    coll += cost_factor * PerfUtils.all_reduce_cost(
-                        aval_bytes(ov.aval), gs.num_splits, self.spec)
+                    coll += cost_factor * PerfUtils.compressed_all_reduce_cost(
+                        aval_bytes(ov.aval), gs.num_splits, self.comm_dtype,
+                        self.spec)
         if gs.reshard_edges:
             # Rule-mode plans record their reshard decisions explicitly
             # (FastSpmdStrategy Solution edges) — price those directly.
@@ -239,7 +246,11 @@ class Evaluator:
             coll += self._reshard_time(graph, gs, produced,
                                        cross_split_vars)
         coll += self._hidden_gather_time(graph, gs, produced)
-        return max(coll, gs.comm_cost or 0.0)
+        # The planner's ILP objective priced fidelity bytes; under a
+        # compressed comm dtype the lower bound shrinks with the wire.
+        from tepdist_tpu.parallel.performance_utils import COMM_DTYPE_RATIOS
+        ratio = COMM_DTYPE_RATIOS.get(self.comm_dtype, 1.0)
+        return max(coll, (gs.comm_cost or 0.0) * ratio)
 
     def _hidden_gather_time(self, graph: JaxprGraph, gs: GraphStrategy,
                             produced: Dict) -> float:

@@ -53,6 +53,7 @@ class PipelineWinner:
     mode: str = "exploration"
     placement: str = "blocked"
     interleave_groups: Any = None
+    comm_dtype: str = ""
 
     def build(self, optimizer, devices=None, **kwargs):
         from tepdist_tpu.parallel.pipeline import plan_pipeline
@@ -61,6 +62,7 @@ class PipelineWinner:
         prog = plan_pipeline(self.loss_fn, self.num_stages,
                              self.num_micro_batches, self.params,
                              *self.example_batch)
+        prog.comm_dtype = self.comm_dtype
         return PipelineExecutable(prog, devices=devices,
                                   optimizer=optimizer,
                                   intra_stage_tp=self.intra_tp,
@@ -88,10 +90,29 @@ def spmd_candidates(graph, n_devices: int,
     for topo in explore_topologies(n_devices):
         try:
             strategies = plan_axes(graph, topo, annotations, "cost")
+            # Fidelity FIRST: Python's min keeps the earliest on exact
+            # cost ties, so a compressed variant must strictly beat the
+            # fidelity plan to win (bit-identity guarantee on ties).
             cost = Evaluator(topo).run(graph, strategies,
                                        num_micro_batches)
             out.append({"kind": "spmd", "topology": topo, "cost": cost,
                         "strategies": strategies})
+            # Comm-dtype candidate modifiers (EQuARX, arXiv:2506.17615):
+            # the SAME sharding re-priced with compressed gradient
+            # collectives — wire bytes shrink by the dtype ratio, a
+            # quantize/dequantize term is added — so the argmin, not an
+            # env knob, decides per candidate where compression wins.
+            # A plan with no priced collectives has nothing to compress:
+            # the re-pricing could only tie (which fidelity wins) or add
+            # overhead, so the variants are skipped, not enumerated.
+            if cost.coll_ratio <= 0.0 or not cost.memory_feasible:
+                continue
+            for dt in ("bfloat16", "int8"):
+                ccost = Evaluator(topo, comm_dtype=dt).run(
+                    graph, strategies, num_micro_batches)
+                out.append({"kind": "spmd", "topology": topo,
+                            "cost": ccost, "strategies": strategies,
+                            "comm_dtype": dt})
         except Exception as e:  # noqa: BLE001 — infeasible proposal
             observatory.record_prune("spmd", str(topo),
                                      "planning_exception", exc=e)
@@ -260,6 +281,30 @@ def pipeline_candidates(loss_fn: Callable, params, example_batch,
                         {"kind": "pipeline", "num_stages": S,
                          "num_micro_batches": M, "intra_tp": tp,
                          "placement": "blocked", "cost": cost})
+                    # Comm-dtype variants: the SAME stage cut with the
+                    # cross-stage SEND/RECV (and any AR) payloads shrunk
+                    # to the wire dtype — the scheduler prices the
+                    # tagged nodes with the compressed ppermute/AR cost.
+                    from tepdist_tpu.runtime.task_graph import (
+                        TaskType as _TT,
+                    )
+                    comm_nodes = [n for n in dag.nodes
+                                  if n.task_type in (_TT.SEND, _TT.RECV,
+                                                     _TT.AR)]
+                    if not comm_nodes or not cost.memory_feasible:
+                        continue
+                    for dt in ("bfloat16", "int8"):
+                        for n in comm_nodes:
+                            n.comm_dtype = dt
+                        ccost = Evaluator(
+                            MeshTopology([("stage", S)])).run_pipeline(dag)
+                        out.append(
+                            {"kind": "pipeline", "num_stages": S,
+                             "num_micro_batches": M, "intra_tp": tp,
+                             "placement": "blocked", "cost": ccost,
+                             "comm_dtype": dt})
+                    for n in comm_nodes:
+                        n.comm_dtype = ""
                 except Exception as e:  # noqa: BLE001
                     observatory.record_prune(
                         "pipeline", f"S={S} M={M} tp={tp}",
@@ -466,6 +511,19 @@ def winner_lowering_postcheck(plan, devices=None) -> List[str]:
     return list(remats)
 
 
+_COMM_DTYPE_SHORT = {"bfloat16": "bf16", "int8": "int8"}
+
+
+def comm_dtype_suffix(comm_dtype: str) -> str:
+    """Render a candidate's comm-dtype modifier as the ``@bf16``/``@int8``
+    config suffix — the ONE rendering shared by candidate_summary and the
+    observatory's candidate_config, so plan_diff joins fidelity and
+    compressed variants of the same config as distinct candidates."""
+    if not comm_dtype or comm_dtype == "float32":
+        return ""
+    return "@" + _COMM_DTYPE_SHORT.get(comm_dtype, comm_dtype)
+
+
 def candidate_summary(candidates, best=None) -> List[Dict[str, Any]]:
     """Wire/debug-friendly ranked table of explored candidates (reference:
     candidate strategy dumps, auto_parallel.cc:309-311)."""
@@ -477,6 +535,7 @@ def candidate_summary(candidates, best=None) -> List[Dict[str, Any]]:
                   else "")
                + (f" il/G={c['interleave_groups']}"
                   if c.get("placement") == "interleaved" else ""))
+        cfg += comm_dtype_suffix(c.get("comm_dtype", ""))
         cost = c["cost"]
         rows.append({
             "kind": c["kind"], "config": cfg,

@@ -66,6 +66,17 @@ GB = 1e9
 # are ~1us; XLA fuses/overlaps, so a small constant suffices for ranking.
 ALPHA_S = 2e-6
 
+# Wire-byte shrink factor per communication dtype relative to f32 payloads.
+# "" / "float32" = fidelity (no compression). The evaluator prices every
+# gradient collective once per dtype and the argmin decides per candidate
+# (EQuARX, arXiv:2506.17615: quantized AllReduce at ~2x inside XLA).
+COMM_DTYPE_RATIOS: Dict[str, float] = {
+    "": 1.0,
+    "float32": 1.0,
+    "bfloat16": 0.5,
+    "int8": 0.25,
+}
+
 
 def _calib():
     """The active calibration profile (telemetry/calibrate.py) or None.
@@ -157,3 +168,50 @@ class PerfUtils:
         if prof is not None and prof.hbm_scale > 0:
             t *= prof.hbm_scale
         return t
+
+    # -- compressed collectives (comm-dtype candidate modifiers) ----------
+    @classmethod
+    def quantize_overhead(cls, bytes_: float, comm_dtype: str,
+                          spec: TpuChipSpec | None = None) -> float:
+        """Quantize + dequantize compute term per participating tensor,
+        modeled as HBM passes over the fidelity payload: one read + one
+        write on each side for the cast, plus one extra read for int8's
+        per-chunk max-abs scale pass. Element-wise, so bandwidth-bound —
+        never MXU-bound."""
+        ratio = COMM_DTYPE_RATIOS.get(comm_dtype, 1.0)
+        if ratio >= 1.0 or bytes_ <= 0:
+            return 0.0
+        passes = 2.0 if comm_dtype != "int8" else 3.0
+        return 2.0 * cls.hbm_time(passes * bytes_, spec)
+
+    @classmethod
+    def compressed_all_reduce_cost(
+            cls, bytes_: float, n: int, comm_dtype: str,
+            spec: TpuChipSpec | None = None,
+            over_dcn: bool = False) -> float:
+        """Ring all-reduce over the SHRUNK wire bytes plus the
+        quantize/dequantize term; degenerates to the fidelity cost for
+        ""/float32."""
+        ratio = COMM_DTYPE_RATIOS.get(comm_dtype, 1.0)
+        return (cls.all_reduce_cost(bytes_ * ratio, n, spec, over_dcn)
+                + cls.quantize_overhead(bytes_, comm_dtype, spec))
+
+    @classmethod
+    def compressed_all_gather_cost(
+            cls, bytes_: float, n: int, comm_dtype: str,
+            spec: TpuChipSpec | None = None,
+            over_dcn: bool = False) -> float:
+        ratio = COMM_DTYPE_RATIOS.get(comm_dtype, 1.0)
+        return (cls.all_gather_cost(bytes_ * ratio, n, spec, over_dcn)
+                + cls.quantize_overhead(bytes_, comm_dtype, spec))
+
+    @classmethod
+    def compressed_ppermute_cost(
+            cls, bytes_: float, comm_dtype: str,
+            spec: TpuChipSpec | None = None,
+            over_dcn: bool = False) -> float:
+        """One neighbor hop on the shrunk wire (pipeline SEND/RECV with a
+        compressed activation payload)."""
+        ratio = COMM_DTYPE_RATIOS.get(comm_dtype, 1.0)
+        return (cls.ppermute_cost(bytes_ * ratio, spec, over_dcn)
+                + cls.quantize_overhead(bytes_, comm_dtype, spec))

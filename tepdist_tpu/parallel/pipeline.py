@@ -34,6 +34,11 @@ class PipelineProgram:
     batch_flat_indices: List[int]   # graph invar indices carrying batch dim
     batch_dim: int
     in_tree: Any
+    # The exploration winner's comm-dtype modifier for this program's
+    # collectives/wire (""/"float32" = fidelity). Set by the winner's
+    # build path; consumed by the task-dag builder (SEND/RECV tagging)
+    # and the executor's gradient-accumulate payloads.
+    comm_dtype: str = ""
 
     @property
     def stages(self):

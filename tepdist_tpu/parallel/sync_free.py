@@ -210,6 +210,7 @@ def build_ga_step(
     num_micro_batches: int,
     batch_argnums: Tuple[int, ...] = (1,),
     batch_dim: int = 0,
+    comm_dtype: str = "",
 ) -> Callable:
     """Construct the sync-free GA training step (reference decomposition
     ENTRY -> {GAINIT, CG, GA, AG} as one scanned program).
@@ -220,15 +221,28 @@ def build_ga_step(
       num_micro_batches: micro ordinal size (a time axis: no devices).
       batch_argnums: positions (in the step signature after params/opt_state)
         of batch-carrying args to split along ``batch_dim``.
+      comm_dtype: the exploration winner's comm-dtype modifier. ""/
+        "float32" = fidelity (bit-identical to the pre-compression step);
+        "bfloat16" = down-cast the per-micro gradient contributions (the
+        FP16_COMM path); "int8" = chunk-scale fake-quant with STOCHASTIC
+        rounding (parallel/quantize.py) so the quantization error is
+        zero-mean across steps.
 
     Returns ``step(params, opt_state, *batch) -> (mean_loss, params, opt)``.
     """
     # FP16_COMM (reference knob; bf16 on TPU): compress the per-micro
     # gradient contributions before accumulation/all-reduce — halves the
-    # cross-replica reduction bytes at bf16 rounding cost.
-    compress = ServiceEnv.get().fp16_comm
+    # cross-replica reduction bytes at bf16 rounding cost. The planner's
+    # comm_dtype="bfloat16" winner takes the same path; "int8" quantizes
+    # through chunk scales instead.
+    compress = ServiceEnv.get().fp16_comm or comm_dtype == "bfloat16"
+    int8 = comm_dtype == "int8"
 
-    def maybe_compress(g):
+    def maybe_compress(g, micro_index):
+        if int8:
+            from tepdist_tpu.parallel.quantize import fake_quant_grads
+            key = jax.random.fold_in(jax.random.PRNGKey(0x7e9d), micro_index)
+            return fake_quant_grads(g, key)
         if not compress:
             return g
         return jax.tree_util.tree_map(
@@ -238,6 +252,12 @@ def build_ga_step(
     if num_micro_batches <= 1:
         def step1(params, opt_state, *batch):
             loss, grads = grad_fn(params, *batch)
+            if int8 or compress:
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: g.astype(p.dtype)
+                    if hasattr(g, "astype") else g,
+                    maybe_compress(grads, jnp.zeros((), jnp.uint32)),
+                    params)
             params, opt_state = apply_fn(params, opt_state, grads)
             return loss, params, opt_state
         return step1
@@ -263,16 +283,18 @@ def build_ga_step(
         # under FP16_COMM: only the per-micro contributions are compressed).
         acc0 = jax.tree_util.tree_map(jnp.zeros_like, params)
 
-        def body(carry, mb):  # CG + GA
+        def body(carry, xs):  # CG + GA
+            micro_index, mb = xs
             acc, loss_sum = carry
             loss, grads = grad_fn(params, *mb)
-            grads = maybe_compress(grads)
+            grads = maybe_compress(grads, micro_index)
             acc = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(a.dtype), acc, grads)
             return (acc, loss_sum + loss), None
 
+        micro_index = jnp.arange(num_micro_batches, dtype=jnp.uint32)
         (acc, loss_sum), _ = lax.scan(
-            body, (acc0, jnp.zeros(())), micro_batches)
+            body, (acc0, jnp.zeros(())), (micro_index, micro_batches))
         inv = 1.0 / num_micro_batches
         grads = jax.tree_util.tree_map(lambda g: g * inv, acc)
         # AG: apply-gradients slice.

@@ -306,15 +306,40 @@ def encode_literal(x, wire_dtype: str = None) -> Tuple[Dict[str, Any], bytes]:
     it is C-contiguous (zero copy); only non-contiguous inputs — or an
     opt-in ``wire_dtype`` down-cast (TEPDIST_WIRE_DTYPE) — materialize,
     so a tensor crosses the wire with at most one copy. The ledger's
-    ``copies`` counter records every materialization."""
+    ``copies`` counter records every materialization.
+
+    ``wire_dtype`` rules (floats only — integer payloads are NEVER cast):
+      * a float dtype name (``bfloat16``/``float16``): down-cast, decode
+        upcasts via ``meta["wire_from"]``;
+      * ``int8``: shape-aware chunk-scale quantization
+        (parallel/quantize.py) — the blob is the f32 per-chunk scale
+        vector followed by the int8 codes, ~26% of the f32 payload.
+    """
     led = wire_ledger.active()
     with span("serde:encode", cat="serde") as sp:
         t0 = time.time_ns() // 1000 if led is not None else 0
         arr = np.asarray(x)
         meta = {"dtype": arr.dtype.name, "shape": list(arr.shape)}
         copies = 0
-        if wire_dtype and arr.dtype in (np.dtype(np.float32),
-                                        np.dtype(np.float64)):
+        is_float = arr.dtype in (np.dtype(np.float32), np.dtype(np.float64))
+        if wire_dtype == "int8" and is_float:
+            from tepdist_tpu.parallel.quantize import (
+                CHUNK,
+                quantize_np_int8,
+            )
+            q, scales = quantize_np_int8(arr, CHUNK)
+            meta["wire_from"] = arr.dtype.name
+            meta["dtype"] = "int8"
+            meta["qscales"] = int(scales.size)
+            meta["qchunk"] = CHUNK
+            blob = scales.tobytes() + q.tobytes()
+            copies = 1
+            sp.set(bytes=len(blob))
+            t1 = time.time_ns() // 1000 if led is not None else 0
+            if led is not None:
+                led.record_encode(t0, t1, copies=copies)
+            return (meta, blob)
+        if wire_dtype and wire_dtype != "int8" and is_float:
             wdt = _resolve_dtype(wire_dtype)
             if wdt != arr.dtype:
                 meta["wire_from"] = arr.dtype.name
@@ -336,12 +361,24 @@ def decode_literal(meta: Dict[str, Any], blob: bytes) -> np.ndarray:
     led = wire_ledger.active()
     with span("serde:decode", cat="serde") as sp:
         t0 = time.time_ns() // 1000 if led is not None else 0
-        dt = _resolve_dtype(meta["dtype"])
         sp.set(bytes=_nbytes(blob))
-        out = np.frombuffer(blob, dtype=dt).reshape(meta["shape"])
-        wire_from = meta.get("wire_from")
-        if wire_from:
-            out = out.astype(_resolve_dtype(wire_from))
+        qscales = meta.get("qscales")
+        if qscales is not None:
+            # int8 chunk-scale wire: f32 scales followed by int8 codes.
+            from tepdist_tpu.parallel.quantize import dequantize_np_int8
+            mv = memoryview(blob)
+            scales = np.frombuffer(mv[:4 * qscales], dtype=np.float32)
+            q = np.frombuffer(mv[4 * qscales:], dtype=np.int8)
+            out = dequantize_np_int8(
+                q, scales, meta["shape"],
+                dtype=_resolve_dtype(meta.get("wire_from") or "float32"),
+                chunk=meta.get("qchunk", 256))
+        else:
+            dt = _resolve_dtype(meta["dtype"])
+            out = np.frombuffer(blob, dtype=dt).reshape(meta["shape"])
+            wire_from = meta.get("wire_from")
+            if wire_from:
+                out = out.astype(_resolve_dtype(wire_from))
         t1 = time.time_ns() // 1000 if led is not None else 0
     if led is not None:
         led.record_decode(t0, t1)

@@ -267,7 +267,11 @@ class WorkerPlan:
         from tepdist_tpu.core.service_env import ServiceEnv
         _env = ServiceEnv.get()
         self._send_overlap = bool(_env.tepdist_send_overlap)
-        self._wire_dtype = _env.tepdist_wire_dtype or None
+        # Peer wire dtype: the local TEPDIST_WIRE_DTYPE knob wins, else
+        # the exploration winner's planned comm dtype shipped in
+        # DispatchPlan's plan_meta (master + every worker agree on it).
+        self._wire_dtype = (_env.tepdist_wire_dtype
+                            or plan_meta.get("comm_dtype", "") or None)
         # Peer-visible address of our transfer server: the bind address is
         # "[::]:port" — advertise our cluster ip instead.
         self._xfer_addr = None

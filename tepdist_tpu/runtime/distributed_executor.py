@@ -66,6 +66,14 @@ class DistributedPipelineSession:
         self.prog = prog
         self.cluster = cluster
         self.lr = learning_rate
+        # Wire compression for MASTER-dispatch envelopes (batch slices in
+        # ExecuteStepSlice / TransferHostRawData): the TEPDIST_WIRE_DTYPE
+        # knob, or the exploration winner's planned comm dtype. Latched
+        # at construction like the workers latch theirs; floats only —
+        # encode_literal never casts integer payloads.
+        from tepdist_tpu.core.service_env import ServiceEnv as _SE
+        self._wire_dtype = (_SE.get().tepdist_wire_dtype
+                            or getattr(prog, "comm_dtype", "") or None)
         DistributedPipelineSession._gen_counter += 1
         self._plan_gen = DistributedPipelineSession._gen_counter
         self._optimizer = optimizer
@@ -235,6 +243,10 @@ class DistributedPipelineSession:
                                 for k, v in send_routes.items()},
                 "recv_keys": recv_keys,
                 "learning_rate": learning_rate,
+                # The winner's comm dtype rides to every worker: peer
+                # host_push frames encode at this dtype when the local
+                # TEPDIST_WIRE_DTYPE knob is unset.
+                "comm_dtype": getattr(prog, "comm_dtype", "") or "",
             }
             # client.call attaches the idempotency token: a retried
             # DispatchPlan whose original landed (response lost) must not
@@ -368,7 +380,8 @@ class DistributedPipelineSession:
                         sl = np.take(leaf,
                                      range(m * msize, (m + 1) * msize),
                                      axis=bdim)
-                        meta, blob = protocol.encode_literal(sl)
+                        meta, blob = protocol.encode_literal(
+                            sl, wire_dtype=self._wire_dtype)
                         entries.append(
                             {"raw_key": f"batch:{step}:{m}:{gi}",
                              "literal": meta})
@@ -425,7 +438,8 @@ class DistributedPipelineSession:
                             sl = np.take(leaf,
                                          range(m * msize, (m + 1) * msize),
                                          axis=bdim)
-                            meta, blob = protocol.encode_literal(sl)
+                            meta, blob = protocol.encode_literal(
+                                sl, wire_dtype=self._wire_dtype)
                             entries.append(
                                 {"raw_key": f"batch:{step}:{m}:{gi}",
                                  "literal": meta})

@@ -265,5 +265,14 @@ def build_pipeline_task_dag(
     for s in range(S):
         dag.add_edge(dag.node(maps.apply_tasks[s]), merge)
 
+    # Winner-planned wire compression: tag every cross-stage transfer
+    # (and any AR) with the program's comm dtype so the scheduler prices
+    # — and the distributed runtime encodes — the compressed payload.
+    cd = getattr(prog, "comm_dtype", "") or ""
+    if cd:
+        for n in dag.nodes:
+            if n.task_type in (TaskType.SEND, TaskType.RECV, TaskType.AR):
+                n.comm_dtype = cd
+
     dag.validate()
     return dag, maps

@@ -467,11 +467,34 @@ class PipelineExecutable:
             # accumulator is donated — only its chain consumes it.
             n_acc = len(param_avals)
 
-            def make_ga_flat(ppos=ppos, n_acc=n_acc):
+            # Winner-planned gradient-contribution compression: the GA
+            # add consumes the bwd output through the comm dtype the
+            # argmin chose (bf16 down-cast, or int8 chunk-scale
+            # stochastic-rounding fake-quant). Fidelity ("") adds the
+            # raw contribution — bit-identical to the uncompressed step.
+            comm_dtype = getattr(self.prog, "comm_dtype", "") or ""
+
+            def make_ga_flat(ppos=ppos, n_acc=n_acc, s=s, cd=comm_dtype):
+                def contrib(g, p):
+                    if not cd or not jnp.issubdtype(g.dtype, jnp.floating):
+                        return g
+                    if cd == "bfloat16":
+                        return g.astype(jnp.bfloat16)
+                    if cd == "int8":
+                        from tepdist_tpu.parallel.quantize import (
+                            fake_quant_int8,
+                        )
+                        key = jax.random.fold_in(
+                            jax.random.PRNGKey(0x7e9d), s * 131 + p)
+                        return fake_quant_int8(g, key)
+                    return g
+
                 def ga(*args):
                     acc = args[:n_acc]
                     bwd_outs = args[n_acc:]
-                    return tuple(a + bwd_outs[p] for a, p in zip(acc, ppos))
+                    return tuple(
+                        a + contrib(bwd_outs[p], p).astype(a.dtype)
+                        for a, p in zip(acc, ppos))
                 return ga
 
             self._ga_jit.append(self._aot(

@@ -72,6 +72,11 @@ class TaskNode:
     payload: Optional[Callable] = None
     flops: float = 0.0
     out_bytes: float = 0.0
+    # Comm-dtype modifier for SEND/RECV/AR payloads (""/"float32" =
+    # fidelity wire). Tagged by the planner's compressed candidates; the
+    # scheduler prices tagged nodes with the compressed collective cost
+    # and the distributed runtime encodes their frames at this dtype.
+    comm_dtype: str = ""
     parents: List[int] = dataclasses.field(default_factory=list)
     children: List[int] = dataclasses.field(default_factory=list)
     # Task ids whose outputs may be freed once this task completes

@@ -258,12 +258,16 @@ class TaskScheduler:
                      else n.parents)
             over_dcn = any(self.dag.nodes[p].worker_id != n.worker_id
                            for p in peers)
-            return max(PerfUtils.ppermute_cost(n.out_bytes, self.spec,
-                                               over_dcn=over_dcn), 1e-7)
+            # Comm-dtype-tagged transfers ride the shrunk wire plus the
+            # quantize/dequantize term (performance_utils).
+            return max(PerfUtils.compressed_ppermute_cost(
+                n.out_bytes, getattr(n, "comm_dtype", ""), self.spec,
+                over_dcn=over_dcn), 1e-7)
         if n.task_type == TaskType.AR:
             ndev = max(len(n.device_group), 1)
-            return max(PerfUtils.all_reduce_cost(n.out_bytes, ndev, self.spec),
-                       1e-7)
+            return max(PerfUtils.compressed_all_reduce_cost(
+                n.out_bytes, ndev, getattr(n, "comm_dtype", ""),
+                self.spec), 1e-7)
         if n.task_type in (TaskType.GA, TaskType.GAINIT, TaskType.APPLY):
             return max(PerfUtils.hbm_time(n.out_bytes, self.spec), 1e-7)
         return 1e-8

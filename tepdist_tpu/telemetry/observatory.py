@@ -93,12 +93,16 @@ def candidate_config(c: Dict[str, Any]) -> str:
     """Stable config string for a candidate dict — the alignment key
     plan_diff joins two reports on (same rendering as
     ``exploration.candidate_summary``)."""
+    from tepdist_tpu.parallel.exploration import comm_dtype_suffix
+
+    suffix = comm_dtype_suffix(c.get("comm_dtype", ""))
     if c["kind"] == "spmd":
-        return str(c["topology"])
+        return str(c["topology"]) + suffix
     return (f"S={c['num_stages']} M={c['num_micro_batches']}"
             + (f" tp={c['intra_tp']}" if c.get("intra_tp", 1) > 1 else "")
             + (f" il/G={c['interleave_groups']}"
-               if c.get("placement") == "interleaved" else ""))
+               if c.get("placement") == "interleaved" else "")
+            + suffix)
 
 
 def cost_terms(cost: Any) -> Dict[str, Any]:

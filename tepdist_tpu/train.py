@@ -210,12 +210,20 @@ def plan_training(
             and num_stages is None):
         explore = True
     explored_winner = None
+    comm_dtype = ""
     if explore and topology is None and num_stages is None:
         best = explore_parallelism(
             loss_fn, params, *example_batch, n_devices=len(devices),
             num_micro_batches=num_micro_batches or 4,
             entry_point="plan_training")
         explored_winner = best
+        # The winner's comm-dtype modifier: the argmin decided whether
+        # compressed gradient collectives pay for themselves on this
+        # model x mesh; fidelity winners run the unchanged step.
+        comm_dtype = best.get("comm_dtype", "")
+        if comm_dtype:
+            log.info("exploration winner compresses gradient collectives "
+                     "to %s", comm_dtype)
         if best["kind"] == "pipeline":
             num_stages = best["num_stages"]
             num_micro_batches = best["num_micro_batches"]
@@ -282,6 +290,7 @@ def plan_training(
         M = num_micro_batches or (
             env.num_micro_batches if env.num_micro_batches > 0 else 2)
         prog = plan_pipeline(loss_fn, num_stages, M, params, *example_batch)
+        prog.comm_dtype = comm_dtype
         # Stage x TP nesting: explicit arg, the exploration winner, a
         # 'model' axis on a caller-provided topology, or the
         # INTRA_STAGE_TP env (config mode, like NUM_STAGES).
@@ -327,7 +336,8 @@ def plan_training(
     n_batch_args = len(example_batch)
     step_fn = build_ga_step(
         grad_fn, apply_fn, num_micro_batches,
-        batch_argnums=tuple(range(1, 1 + n_batch_args)))
+        batch_argnums=tuple(range(1, 1 + n_batch_args)),
+        comm_dtype=comm_dtype)
 
     if topology is None:
         n = len(devices)

@@ -52,7 +52,10 @@ def test_gpt2_8layer_s4_tp2_exact(devices):
         updates, ss = tx.update(g, ss, pp)
         return optax.apply_updates(pp, updates), ss
 
-    ref_step = jax.jit(prog.reference_step(apply_fn))
+    # Eager on purpose: jitting this reference XLA-compiles the unrolled
+    # M=4 x 8-layer train step (~40s on CPU) for two evaluations; the
+    # op-by-op trajectory is identical within the tolerances below.
+    ref_step = prog.reference_step(apply_fn)
     opt_state = tx.init(params)
     ref_losses = []
     pref = params
@@ -66,15 +69,13 @@ def test_gpt2_8layer_s4_tp2_exact(devices):
             np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
         got, jax.device_get(pref))
 
-    # Steady-state step time, recorded for the pinned protocol's depth
-    # line (tools/bench_runtime.py prints the driver-run number).
-    best = None
+    # Steady-state step time, informational only — the pinned protocol's
+    # depth number comes from tools/bench_runtime.py, so one short
+    # post-warmup sample is enough here.
+    t0 = time.perf_counter()
     for _ in range(2):
-        t0 = time.perf_counter()
-        for _ in range(3):
-            exe.step(toks)
-        dt = (time.perf_counter() - t0) / 3
-        best = dt if best is None else min(best, dt)
+        exe.step(toks)
+    best = (time.perf_counter() - t0) / 2
     print(f"\n[depth] gpt2-8L S=4 x TP=2 task-graph: {best * 1e3:.1f} "
           "ms/step on the 8-device CPU mesh")
     assert best > 0

@@ -1,0 +1,176 @@
+"""Memory-efficient redistribution tests (arXiv:2112.01075): pairwise
+slice intersections instead of full materialization, and the checkpoint
+cross-mesh restore path built on them — a plan explored on one mesh
+(compressed-collective winners included) must restore onto another."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tepdist_tpu.parallel.redistribution import (
+    assemble_shard,
+    overlap,
+    plan_redistribution,
+    redistribution_cost,
+)
+from tepdist_tpu.runtime.checkpoint import CheckpointUtil
+
+
+def _grid(shape, cuts):
+    """Split ``shape`` into a regular grid of bounds; ``cuts[d]`` parts
+    along dimension d."""
+    def splits(dim, k):
+        step = dim // k
+        return [(i * step, dim if i == k - 1 else (i + 1) * step)
+                for i in range(k)]
+
+    bounds = [()]
+    for dim, k in zip(shape, cuts):
+        bounds = [b + (s,) for b in bounds for s in splits(dim, k)]
+    return bounds
+
+
+def test_overlap_basic():
+    assert overlap(((0, 4), (0, 8)), ((2, 6), (4, 12))) == ((2, 4), (4, 8))
+    assert overlap(((0, 4),), ((4, 8),)) is None
+
+
+def test_plan_rows_to_cols_exact():
+    """2 row-shards -> 2 col-shards: every dst shard draws from both
+    sources and reassembles the global array exactly."""
+    full = np.arange(64, dtype=np.float32).reshape(8, 8)
+    src = _grid((8, 8), (2, 1))      # rows
+    dst = _grid((8, 8), (1, 2))      # cols
+    plan = plan_redistribution(src, dst)
+    assert all(len(p) == 2 for p in plan)
+
+    def fetch(i, inter):
+        piece = full[tuple(slice(lo, hi) for lo, hi in src[i])]
+        return piece[tuple(slice(lo - a, hi - a)
+                           for (lo, hi), (a, _z) in zip(inter, src[i]))]
+
+    for d, pieces in zip(dst, plan):
+        got = assemble_shard(d, pieces, fetch, np.float32)
+        np.testing.assert_array_equal(
+            got, full[tuple(slice(lo, hi) for lo, hi in d)])
+
+
+def test_plan_incomplete_coverage_raises():
+    src = [((0, 4), (0, 8))]          # top half only
+    dst = _grid((8, 8), (1, 2))
+    with pytest.raises(ValueError, match="coverage incomplete"):
+        plan_redistribution(src, dst)
+
+
+def test_plan_dedups_replicated_sources():
+    full_b = ((0, 8), (0, 8))
+    plan = plan_redistribution([full_b, full_b], [full_b])
+    assert plan == [[(0, full_b)]]
+
+
+def test_cost_identity_layout_moves_nothing():
+    src = _grid((8, 8), (2, 1))
+    c = redistribution_cost(src, src, elem_bytes=4)
+    assert c["moved_bytes"] == 0.0
+    assert c["transfer_s"] == 0.0
+
+
+def test_cost_reshard_cheaper_than_full_materialize():
+    src = _grid((1024, 1024), (4, 1))
+    dst = _grid((1024, 1024), (1, 4))
+    c = redistribution_cost(src, dst, elem_bytes=4)
+    total = 1024 * 1024 * 4
+    assert 0 < c["moved_bytes"] <= total
+    assert c["transfer_s"] > 0
+    # The whole point: peak residency is one dst shard + one piece,
+    # far below assembling the global array.
+    assert c["peak_bytes"] < c["full_materialize_bytes"]
+    assert c["peak_bytes"] < total
+
+
+def _write_row_shards(tmp_path, full, step=5):
+    """Emit the exact multi-controller files CheckpointUtil.save writes:
+    worker w holds rows [w*4, w*4+4)."""
+    util = CheckpointUtil(str(tmp_path))
+    util.save(step, {})
+    step_dir = tmp_path / f"step_{step:012d}"
+    for w, (lo, hi) in enumerate([(0, 4), (4, 8)]):
+        np.savez(step_dir / f"worker{w}.npz",
+                 **{"w::shard0": full[lo:hi]})
+        with open(step_dir / f"worker{w}.meta.json", "w") as f:
+            json.dump({"w::shard0": {
+                "of": "w", "index": [[lo, hi], [0, 8]],
+                "global_shape": [8, 8]}}, f)
+    return util
+
+
+def test_restore_resharded_rows_to_cols(tmp_path):
+    full = np.arange(64, dtype=np.float32).reshape(8, 8)
+    util = _write_row_shards(tmp_path, full)
+    dsts = _grid((8, 8), (1, 2))
+    out, step = util.restore_resharded({"w": [list(d) for d in dsts]})
+    assert step == 5
+    for d, got in zip(dsts, out["w"]):
+        np.testing.assert_array_equal(
+            got, full[tuple(slice(lo, hi) for lo, hi in d)])
+
+
+def test_restore_resharded_finer_grid(tmp_path):
+    """Restoring onto MORE shards than were saved (2 -> 4, the grow-the-
+    mesh case a compressed winner's plan change triggers)."""
+    full = np.arange(64, dtype=np.float32).reshape(8, 8)
+    util = _write_row_shards(tmp_path, full)
+    dsts = _grid((8, 8), (2, 2))
+    out, _ = util.restore_resharded({"w": dsts})
+    for d, got in zip(dsts, out["w"]):
+        np.testing.assert_array_equal(
+            got, full[tuple(slice(lo, hi) for lo, hi in d)])
+
+
+def test_restore_resharded_unknown_name_raises(tmp_path):
+    util = _write_row_shards(tmp_path, np.zeros((8, 8), np.float32))
+    with pytest.raises(KeyError, match="no sharded entry"):
+        util.restore_resharded({"nope": [((0, 8), (0, 8))]})
+
+
+def test_restore_sharded_onto_different_mesh(tmp_path):
+    """End-to-end: save on a 2-device row mesh, restore with a col-mesh
+    target sharding — restore_sharded must route through the
+    redistribution path and produce the same global array."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tepdist_tpu.runtime.checkpoint import (
+        restore_sharded,
+        save_sharded,
+    )
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices")
+    full = np.arange(64, dtype=np.float32).reshape(8, 8)
+    mesh_r = Mesh(np.array(devs[:2]), ("x",))
+    arr = jax.device_put(full, NamedSharding(mesh_r, P("x", None)))
+    treedef = save_sharded(str(tmp_path), 3, [arr])
+
+    mesh_c = Mesh(np.array(devs[:2]), ("y",))
+    tgt = NamedSharding(mesh_c, P(None, "y"))
+    # Fully-addressable arrays are stored whole; force the sharded write
+    # format by rewriting the step as two row shards (the multi-host
+    # layout) before restoring.
+    if not (tmp_path / "step_000000000003" / "worker0.meta.json").exists():
+        step_dir = tmp_path / "step_000000000003"
+        np.savez(step_dir / "worker0.npz", **{"0::shard0": full[:4]})
+        with open(step_dir / "worker0.meta.json", "w") as f:
+            json.dump({"0::shard0": {"of": "0", "index": [[0, 4], [0, 8]],
+                                     "global_shape": [8, 8]}}, f)
+        np.savez(step_dir / "worker1.npz", **{"0::shard0": full[4:]})
+        with open(step_dir / "worker1.meta.json", "w") as f:
+            json.dump({"0::shard0": {"of": "0", "index": [[4, 8], [0, 8]],
+                                     "global_shape": [8, 8]}}, f)
+    (tree, step) = restore_sharded(str(tmp_path), treedef, shardings=[tgt])
+    assert step == 3
+    got = np.asarray(tree[0])
+    np.testing.assert_array_equal(got, full)
+    assert tree[0].sharding.is_equivalent_to(tgt, 2)
