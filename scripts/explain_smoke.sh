@@ -64,9 +64,14 @@ def report(**env):
             ServiceEnv.reset()
 
 # base / again: identical fixture twice (determinism contract);
-# perturbed: tiny HBM makes full replication memory-infeasible.
+# perturbed: tight HBM makes the replicated-state SPMD winners
+# memory-infeasible while a sharded pipeline candidate still fits.
+# 0.024 GB sits in the flip window now that the evaluator charges
+# OPT_STATE_FACTOR x grad bytes of optimizer state per device —
+# starving further (e.g. 0.005) kills EVERY candidate and nothing
+# flips.
 for name, rep in (("base", report()), ("again", report()),
-                  ("perturbed", report(HBM_GB=0.005))):
+                  ("perturbed", report(HBM_GB=0.024))):
     with open(os.path.join(out, f"{name}.json"), "w") as f:
         json.dump(rep, f)
 PY

@@ -1,16 +1,27 @@
 #!/usr/bin/env python
-"""Regenerate the committed comm-dtype winner-flip fixtures.
+"""Regenerate the committed winner-flip fixtures.
 
-Runs the full exploration twice over the GPT-2 ``test`` config graph —
-once at healthy interconnect bandwidth (the fidelity mesh wins) and once
-at starved bandwidth (the int8-compressed data-parallel mesh wins) — and
-writes the observatory ExplorationReports to ``tests/fixtures/``:
+Runs the full exploration over the GPT-2 ``test`` config graph under a
+seeded environment perturbation and writes the observatory
+ExplorationReports to ``tests/fixtures/``:
 
     coll_flip_before.json   ICI 400 GB/s  -> fidelity winner
     coll_flip_after.json    ICI 5 MB/s    -> @int8 winner, driver coll_s
+    zero_flip_before.json   healthy HBM   -> fidelity winner
+    zero_flip_after.json    HBM 2.4 MB    -> @zero winner, driver
+                                             memory_feasible
 
-``tools/plan_diff.py before after --expect-flip coll_s`` must pass on
-the pair; scripts/quant_smoke.sh and tests/test_comm_dtype.py assert it.
+The comm-dtype pair starves interconnect bandwidth until the compressed
+wire pays for itself. The ZeRO pair starves HBM until the fidelity
+winner's replicated optimizer state (OPT_STATE_FACTOR x grad bytes per
+device) blows the budget while the same mesh's @zero candidate — state
+sharded 1/dp over the data axis — still fits; the old winner stays
+enumerated (infeasible) in the after report, so the diff names
+``memory_feasible`` as the driver.
+
+``tools/plan_diff.py before after --expect-flip`` must pass on each
+pair; scripts/quant_smoke.sh, scripts/zero_smoke.sh,
+tests/test_comm_dtype.py and tests/test_zero.py assert it.
 """
 import json
 import os
@@ -30,10 +41,16 @@ from tepdist_tpu.parallel.exploration import explore
 
 OUT = os.path.join(os.path.dirname(__file__), "..", "tests", "fixtures")
 
+# The ZeRO flip window on the GPT-2 test graph at 8 devices: the
+# (data=2, model=2, model2=2) fidelity winner peaks at ~2.54 MB/device
+# (opt state ~1.01 MB replicated over data), its @zero variant at
+# ~2.03 MB. A 2.4e-3 GB budget (x0.9 usage -> 2.16 MB) lands between.
+ZERO_FLIP_HBM_GB = 0.0024
 
-def report(ici_gbps: float):
+
+def report(env: dict):
     try:
-        ServiceEnv.reset({"ICI_BANDWIDTH": ici_gbps})
+        ServiceEnv.reset(env)
         cfg = gpt2.CONFIGS["test"]
         params = jax.eval_shape(
             lambda k: gpt2.init_params(cfg, k), jax.random.PRNGKey(0))
@@ -45,9 +62,10 @@ def report(ici_gbps: float):
         best = explore(loss, params, toks, n_devices=8,
                        num_micro_batches=2, include_pipeline=False,
                        include_seq=False)
-        print(f"ICI {ici_gbps}: winner kind={best.get('kind')} "
+        print(f"{env}: winner kind={best.get('kind')} "
               f"config={best.get('config')!r} "
-              f"comm_dtype={best.get('comm_dtype', '')!r}")
+              f"comm_dtype={best.get('comm_dtype', '')!r} "
+              f"zero={best.get('zero', False)}")
         return best["report"]
     finally:
         ServiceEnv.reset()
@@ -55,8 +73,17 @@ def report(ici_gbps: float):
 
 def main():
     os.makedirs(OUT, exist_ok=True)
-    for name, rep in (("coll_flip_before.json", report(400.0)),
-                      ("coll_flip_after.json", report(0.005))):
+    pairs = (
+        ("coll_flip_before.json", {"ICI_BANDWIDTH": 400.0}),
+        ("coll_flip_after.json", {"ICI_BANDWIDTH": 0.005}),
+        # Healthy bandwidth in BOTH ZeRO fixtures: the flip must be
+        # memory-driven, not wire-driven.
+        ("zero_flip_before.json", {"ICI_BANDWIDTH": 400.0}),
+        ("zero_flip_after.json", {"ICI_BANDWIDTH": 400.0,
+                                  "HBM_GB": ZERO_FLIP_HBM_GB}),
+    )
+    for name, env in pairs:
+        rep = report(env)
         path = os.path.join(OUT, name)
         with open(path, "w") as f:
             json.dump(rep, f, indent=1, sort_keys=True)

@@ -1,0 +1,106 @@
+#!/usr/bin/env bash
+# ZeRO weight-update sharding smoke (ISSUE 14): prove the optimizer-
+# state-sharding planner modifier + sharded update paths end to end.
+#
+#   1. FLIP FIXTURE: the committed before/after ExplorationReports
+#      (scripts/gen_flip_fixtures.py — GPT-2 graph at healthy vs starved
+#      HBM, healthy wire in BOTH) MUST flip the winner to an @zero mesh
+#      with memory_feasible as the named driver (plan_diff --check
+#      fails, --expect-flip passes).
+#   2. LEDGER: tools/plan_explain.py renders the fixture's candidate
+#      table with the per-candidate opt_MB column and --check accounts
+#      every proposal.
+#   3. NUMERICS: ZeRO-DP tracks plain DP to accumulation tolerance; the
+#      planner zero_invars path matches and halves per-device state.
+#   4. MEMORY: bench_zero_opt_mem's measured per-device optimizer-state
+#      ratio clears the 1.8x gate at dp=2.
+#   5. PERF GATE: the ratio records as a trend; the fixture flip passes
+#      --plan-diff only when a gated key measurably improved; a seeded
+#      30% regression on zero_opt_mem_x MUST trip the gate.
+#
+# Override the per-pass bound with ZERO_SMOKE_TIMEOUT (seconds).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${ZERO_SMOKE_TIMEOUT:-600}"
+TMPDIR_SMOKE="$(mktemp -d)"
+trap 'rm -rf "$TMPDIR_SMOKE"' EXIT
+export JAX_PLATFORMS=cpu
+
+BEFORE="tests/fixtures/zero_flip_before.json"
+AFTER="tests/fixtures/zero_flip_after.json"
+
+echo "=== zero smoke 1/5: committed winner-flip fixtures (driver memory_feasible) ==="
+if timeout -k 10 "$TIMEOUT" python tools/plan_diff.py \
+    "$BEFORE" "$AFTER" --check > /dev/null 2>&1; then
+    echo "zero smoke: FAIL (fixture flip did not fail plan_diff --check)"
+    exit 1
+fi
+timeout -k 10 "$TIMEOUT" python tools/plan_diff.py \
+    "$BEFORE" "$AFTER" --expect-flip | tee "$TMPDIR_SMOKE/flip.txt"
+grep -q "driver: memory_feasible" "$TMPDIR_SMOKE/flip.txt" || {
+    echo "zero smoke: FAIL (flip driver is not memory_feasible)"; exit 1; }
+grep -q "@zero" "$TMPDIR_SMOKE/flip.txt" || {
+    echo "zero smoke: FAIL (new winner is not a ZeRO candidate)"
+    exit 1; }
+
+echo "=== zero smoke 2/5: candidate ledger + opt_MB column (plan_explain) ==="
+timeout -k 10 "$TIMEOUT" python tools/plan_explain.py \
+    "$AFTER" | tee "$TMPDIR_SMOKE/explain.txt"
+grep -q "opt_MB" "$TMPDIR_SMOKE/explain.txt" || {
+    echo "zero smoke: FAIL (plan_explain lacks the opt_MB column)"
+    exit 1; }
+grep -q "@zero" "$TMPDIR_SMOKE/explain.txt" || {
+    echo "zero smoke: FAIL (plan_explain lacks @zero candidates)"
+    exit 1; }
+timeout -k 10 "$TIMEOUT" python tools/plan_explain.py --fixture --check
+
+echo "=== zero smoke 3/5: ZeRO-DP numerics + planner path ==="
+timeout -k 10 "$TIMEOUT" python -m pytest tests/test_zero.py -q \
+    -p no:cacheprovider \
+    -k "tracks_plain or composes_with_int8 or zero_invars"
+
+echo "=== zero smoke 4/5: measured per-device optimizer-state shrink ==="
+ZMEM="$(timeout -k 10 "$TIMEOUT" python - <<'PY'
+import bench
+r = bench.bench_zero_opt_mem()
+assert r["gate_1p8x"], f"zero_opt_mem_x below 1.8x: {r}"
+print(f"{r['value']:.3f}")
+PY
+)"
+echo "zero_opt_mem_x = $ZMEM (gate: >= 1.8)"
+
+echo "=== zero smoke 5/5: perf gate — flip coverage + seeded regression ==="
+HIST_IMP="$TMPDIR_SMOKE/hist_improved.jsonl"
+HIST_REG="$TMPDIR_SMOKE/hist_flat.jsonl"
+BASE="$(python -c "print(float('$ZMEM') / 2)")"
+for i in 1 2 3; do
+    timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST_IMP" \
+        --record-value "zero_opt_mem_x=$BASE" > /dev/null
+    timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST_REG" \
+        --record-value "zero_opt_mem_x=$ZMEM" > /dev/null
+done
+# The flip is covered: zero_opt_mem_x improved vs the replicated-state
+# baseline, so the plan change pays for itself and the gate passes.
+timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST_IMP" \
+    --check --keys zero_opt_mem_x \
+    --record-value "zero_opt_mem_x=$ZMEM" \
+    --plan-diff "$BEFORE,$AFTER"
+# The same flip with NO bench improvement is an unexplained plan change.
+if timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST_REG" \
+    --check --keys zero_opt_mem_x \
+    --record-value "zero_opt_mem_x=$ZMEM" \
+    --plan-diff "$BEFORE,$AFTER" > /dev/null 2>&1; then
+    echo "zero smoke: FAIL (uncovered winner flip did not trip the gate)"
+    exit 1
+fi
+# A seeded 30% regression on the ratio MUST trip the gate.
+if timeout -k 10 "$TIMEOUT" python tools/perf_gate.py --history "$HIST_REG" \
+    --check --keys zero_opt_mem_x \
+    --record-value "zero_opt_mem_x=$ZMEM" \
+    --seed-regression zero_opt_mem_x:30; then
+    echo "zero smoke: FAIL (seeded 30% regression did not trip the gate)"
+    exit 1
+fi
+
+echo "zero smoke: PASS"

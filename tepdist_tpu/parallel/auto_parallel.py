@@ -50,6 +50,12 @@ class ParallelPlan:
     # Consumed by train.plan_training when it rebuilds the GA step and by
     # the RPC dispatch plumbing; the plan's OWN jit is dtype-agnostic.
     comm_dtype: str = ""
+    # ZeRO weight-update sharding (arXiv:2004.13336): True when the
+    # optimizer-state invars were force-split over the data axis
+    # (apply_zero_sharding) so GSPMD emits reduce-scatter + sharded apply
+    # + updated-param all-gather. Consumed by train.plan_training (state
+    # placement + checkpointing) and the plan_meta fleet plumbing.
+    zero: bool = False
 
     _flat_cache: Any = None     # donate tuple -> jitted flat step fn
     _mesh: Any = None
@@ -323,6 +329,60 @@ def _mem_save_dim_cost(graph: JaxprGraph, gs: GraphStrategy, v: Var,
     return total
 
 
+def apply_zero_sharding(
+    graph: JaxprGraph,
+    strategies: List[GraphStrategy],
+    topology: MeshTopology,
+    zero_invars: Sequence[int],
+    axis: str = "data",
+) -> List[int]:
+    """ZeRO-1 realization for the single-jit SPMD path (ISSUE 14,
+    arXiv:2004.13336): force-split the OPTIMIZER-STATE invars over the
+    data axis in their ORIGINAL shapes. With ``state_alias`` forcing
+    out := in specs, GSPMD then lowers the apply as the ZeRO update —
+    the gradient psum's output is consumed sliced (reduce-scatter), the
+    elementwise optimizer update runs on the local shard only, and the
+    updated params (whose storage stays replicated) all-gather.
+
+    Original shapes — NOT a (dp, chunk) re-layout — so the shard extents
+    are natural NamedSharding slices: CheckpointUtil writes them as
+    ``::shard`` entries and ``restore_resharded`` can reassemble onto ANY
+    DP width (a padded flat layout would make the global length
+    dp-dependent and break cross-width restore).
+
+    Returns the invar indices actually split (leaves with no dim
+    divisible by dp — scalars like Adam's step count — stay replicated;
+    they are O(bytes) irrelevant)."""
+    axis_names = [nm for nm, sz in topology.device_axes() if sz > 1]
+    if axis not in axis_names:
+        return []
+    gs = strategies[axis_names.index(axis)]
+    n = gs.num_splits
+    split: List[int] = []
+    for i in zero_invars:
+        v = graph.invars[i]
+        cur = gs.var_strategies.get(v)
+        if cur is not None and cur.is_split():
+            split.append(i)
+            continue   # planner/mem-save already sharded it — same effect
+        shape = v.aval.shape
+        taken = {s.partition_dim for g in strategies if g is not gs
+                 if (s := g.var_strategies.get(v)) is not None
+                 and s.is_split()}
+        best = None
+        for d in range(len(shape)):
+            if d in taken or shape[d] % n or shape[d] < n:
+                continue
+            c = _mem_save_dim_cost(graph, gs, v, d, n)
+            key = (c, -shape[d])
+            if best is None or key < best[0]:
+                best = (key, d)
+        if best is not None:
+            gs.var_strategies[v] = DimStrategy.split_on(best[1], n)
+            split.append(i)
+    return split
+
+
 def align_state_storage(
     graph: JaxprGraph,
     strategies: List[GraphStrategy],
@@ -375,6 +435,7 @@ def auto_parallel(
     mode: Optional[str] = None,
     state_alias: Optional[Dict[int, int]] = None,
     var_mem_limit: Optional[int] = None,
+    zero_invars: Optional[Sequence[int]] = None,
     **example_kwargs,
 ) -> ParallelPlan:
     """Plan ``fn`` over ``topology``. Modes: "cost" (default), "rule".
@@ -382,7 +443,10 @@ def auto_parallel(
     ``state_alias``: outvar flat index -> invar flat index for training-state
     threading (forces matching shardings across steps). ``var_mem_limit``
     (or the VAR_MEM_LIMIT env): per-device variable-byte budget triggering
-    ZeRO-style storage splitting."""
+    ZeRO-style storage splitting. ``zero_invars``: flat invar indices of
+    the OPTIMIZER-STATE leaves to force-shard over the data axis
+    (``apply_zero_sharding`` — the exploration winner's ``@zero``
+    modifier realized by the planner)."""
     env = ServiceEnv.get()
     if mode is None:
         mode = "rule" if env.rule_mode else "cost"
@@ -418,6 +482,15 @@ def auto_parallel(
             unify_group_strategies(graph, strategies, groups)
         except Exception as e:  # noqa: BLE001 — affinity is an optimization
             log.warning("affinity unification skipped: %s", e)
+    zero_split: List[int] = []
+    if zero_invars:
+        # After affinity unification on purpose: ZeRO-1 wants the state
+        # slots SPLIT while params stay replicated, the opposite of the
+        # slots-adopt-param-sharding affinity default.
+        zero_split = apply_zero_sharding(graph, strategies, topology,
+                                         zero_invars)
+        log.info("ZeRO: sharded %d/%d optimizer-state invars over the "
+                 "data axis", len(zero_split), len(zero_invars))
     xform = SpmdTransform(graph, topology)
     sharding_plan = xform.lower(strategies, state_alias=state_alias)
     return ParallelPlan(
@@ -428,6 +501,7 @@ def auto_parallel(
         in_tree=in_tree,
         out_tree=out_tree,
         mode=mode,
+        zero=bool(zero_split),
     )
 
 
@@ -563,7 +637,8 @@ def _materialize_explored(best, fn, graph, in_tree, out_tree, example_args,
             loss_fn=fn, params=params, example_batch=tuple(batch),
             placement=best.get("placement", "blocked"),
             interleave_groups=best.get("interleave_groups"),
-            comm_dtype=best.get("comm_dtype", ""))
+            comm_dtype=best.get("comm_dtype", ""),
+            zero=best.get("zero", False))
 
     topo = best["topology"]
     is_seq = any(n == "seq" and s > 1 for n, s in topo.device_axes())
@@ -595,6 +670,7 @@ def _materialize_explored(best, fn, graph, in_tree, out_tree, example_args,
         sharding_plan=sharding_plan, in_tree=in_tree, out_tree=out_tree,
         mode="exploration",
         comm_dtype=best.get("comm_dtype", ""),
+        zero=best.get("zero", False),
     )
     plan.cost = best["cost"]
     plan.candidates = candidates

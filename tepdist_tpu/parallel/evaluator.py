@@ -64,6 +64,11 @@ class Cost:
     bubble_ratio: float            # pipeline bubbles / total
     peak_bytes_per_device: float
     memory_feasible: bool
+    # Per-device optimizer-state bytes priced into ``peak_bytes_per_device``
+    # (ISSUE 14: state is no longer free — ZeRO candidates shrink this by
+    # 1/dp). Defaulted so Cost dicts serialized before the field existed
+    # still load.
+    opt_state_bytes_per_device: float = 0.0
 
     def key(self) -> float:
         # Infeasible plans lose to any feasible plan.
@@ -72,16 +77,24 @@ class Cost:
 
 class Evaluator:
     def __init__(self, topology: MeshTopology, chip=None,
-                 usage_ratio: float = 0.9, comm_dtype: str = ""):
+                 usage_ratio: float = 0.9, comm_dtype: str = "",
+                 zero: bool = False):
         """``comm_dtype``: price gradient collectives at a compressed wire
         dtype (""/"float32" = fidelity, "bfloat16", "int8"). Only the
         partial-resolution psums (gradient AllReduce) compress — reshard
         edges and hidden gathers move activations/params whose consumers
-        need full precision, so they stay at fidelity bytes."""
+        need full precision, so they stay at fidelity bytes.
+
+        ``zero``: price the candidate with ZeRO-1 weight-update sharding
+        over the data axis (arXiv:2004.13336) — optimizer state shrinks to
+        1/dp per device, and the gradient all-reduce is replaced by
+        reduce-scatter + updated-param all-gather (both composing with
+        ``comm_dtype``)."""
         self.topology = topology
         self.spec = chip or chip_spec()
         self.usage_ratio = usage_ratio
         self.comm_dtype = comm_dtype
+        self.zero = zero
 
     # -- SPMD ------------------------------------------------------------
     def _reshard_time(self, graph: JaxprGraph, gs: GraphStrategy,
@@ -398,7 +411,14 @@ class Evaluator:
                                       if j != i)) or None
             coll_t += self.derived_comm(graph, gs, produced, cross)
 
-        # Memory: parameters (sharded where split) + activation peak.
+        # Memory: parameters (sharded where split) + activation peak
+        # + optimizer state. The state term (ISSUE 14 / ROADMAP item 4)
+        # was FREE before: a dp-wide replica set held dp full Adam-moment
+        # copies the feasibility gate never saw, so the planner could not
+        # see the one scenario ZeRO exists for. The traced step graph is
+        # value_and_grad's (loss, grads) — every non-scalar outvar mirrors
+        # a param leaf, so gradient bytes double as the state-payload base.
+        from tepdist_tpu.parallel.performance_utils import OPT_STATE_FACTOR
         from tepdist_tpu.parallel.sync_free import (
             estimate_peak_activation_bytes,
         )
@@ -413,7 +433,38 @@ class Evaluator:
                 if s is not None and s.is_split():
                     factor *= s.num_splits
             var_bytes += b / factor
-        peak = act_peak + var_bytes
+        grad_bytes = 0.0
+        dp_grad_psum = False
+        axis_names = [nm for nm, sz in self.topology.device_axes()
+                      if sz > 1]   # strategies align 1:1 (plan_axes order)
+        for ov in graph.outvars:
+            if not isinstance(ov, Var) or not ov.aval.shape:
+                continue
+            b = float(aval_bytes(ov.aval))
+            for nm, gs, prod in zip(axis_names, strategies, produced_maps):
+                s = prod.get(ov)
+                if s is not None and s.is_split():
+                    b /= gs.num_splits
+                if nm == "data" and s is not None and s.partial:
+                    dp_grad_psum = True
+            grad_bytes += b
+        opt_bytes = OPT_STATE_FACTOR * grad_bytes
+        dp = next((sz for nm, sz in self.topology.device_axes()
+                   if nm == "data" and sz > 1), 1)
+        if self.zero and dp > 1:
+            opt_bytes /= dp
+            # RS(grads) + sharded apply + AG(updated params) replaces the
+            # data axis's gradient all-reduce. Net ~ +ALPHA_S*(dp-1) at
+            # equal bytes (ring algebra), so ZeRO never wins on pure
+            # seconds — it must win via memory feasibility, which is why
+            # fidelity-first tie-breaking stays safe.
+            delta = PerfUtils.zero_update_cost(
+                grad_bytes, dp, self.comm_dtype, self.spec)
+            if dp_grad_psum:
+                delta -= PerfUtils.compressed_all_reduce_cost(
+                    grad_bytes, dp, self.comm_dtype, self.spec)
+            coll_t += max(delta, 0.0)
+        peak = act_peak + var_bytes + opt_bytes
         budget = self.spec.hbm_gb * 1e9 * self.usage_ratio
 
         # Compute/comm overlap (VERDICT r2 weak #4): XLA overlaps async
@@ -433,14 +484,22 @@ class Evaluator:
             bubble_ratio=0.0,
             peak_bytes_per_device=peak,
             memory_feasible=peak <= budget,
+            opt_state_bytes_per_device=opt_bytes,
         )
 
     # -- pipeline --------------------------------------------------------
-    def run_pipeline(self, dag, chip=None) -> Cost:
+    def run_pipeline(self, dag, chip=None, opt_state_bytes: float = 0.0,
+                     zero_dp: int = 1, zero_comm_s: float = 0.0) -> Cost:
         """Pipeline plans: the TaskScheduler simulation is the cost model
         (cross-worker Send/Recv priced at DCN bandwidth inside the
         scheduler's time model); coll/bubble ratios come from the schedule
-        rather than being reported as zero (VERDICT r1 weak #1)."""
+        rather than being reported as zero (VERDICT r1 weak #1).
+
+        ``opt_state_bytes``: per-device optimizer-state bytes of the stage
+        owner under fidelity (the scheduler's activation/weight model does
+        not see the optimizer); divided by ``zero_dp`` when the candidate
+        shards the weight update, with ``zero_comm_s`` the priced
+        reduce-scatter + all-gather substitution added to the makespan."""
         from tepdist_tpu.runtime.task_graph import TaskType
         from tepdist_tpu.runtime.task_scheduler import TaskScheduler
 
@@ -451,18 +510,22 @@ class Evaluator:
         # window is chosen), not merely reported after the fact.
         ts = TaskScheduler(dag, chip=spec, mem_limit_bytes=budget)
         sched = ts.schedule()
-        peak = max(sched.peak_bytes.values(), default=0.0)
+        state = opt_state_bytes / max(zero_dp, 1)
+        peak = max(sched.peak_bytes.values(), default=0.0) + state
         busy = 1.0 - sched.bubble_ratio
         devices = {d for n in dag.nodes for d in n.device_group} or {0}
         comm_t = sum(
             ts.task_time(n) for n in dag.nodes
             if n.task_type in (TaskType.SEND, TaskType.RECV, TaskType.AR))
-        coll = comm_t / (sched.makespan * len(devices)) if sched.makespan else 0.0
+        comm_t += zero_comm_s
+        makespan = sched.makespan + zero_comm_s
+        coll = comm_t / (makespan * len(devices)) if makespan else 0.0
         return Cost(
-            total_duration=sched.makespan,
+            total_duration=makespan,
             compute_efficiency=busy,
             coll_ratio=min(coll, 1.0),
             bubble_ratio=sched.bubble_ratio,
             peak_bytes_per_device=peak,
-            memory_feasible=sched.memory_feasible,
+            memory_feasible=sched.memory_feasible and peak <= budget,
+            opt_state_bytes_per_device=state,
         )

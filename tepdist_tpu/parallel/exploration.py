@@ -54,6 +54,7 @@ class PipelineWinner:
     placement: str = "blocked"
     interleave_groups: Any = None
     comm_dtype: str = ""
+    zero: bool = False
 
     def build(self, optimizer, devices=None, **kwargs):
         from tepdist_tpu.parallel.pipeline import plan_pipeline
@@ -63,6 +64,7 @@ class PipelineWinner:
                              self.num_micro_batches, self.params,
                              *self.example_batch)
         prog.comm_dtype = self.comm_dtype
+        prog.zero = self.zero
         return PipelineExecutable(prog, devices=devices,
                                   optimizer=optimizer,
                                   intra_stage_tp=self.intra_tp,
@@ -105,14 +107,33 @@ def spmd_candidates(graph, n_devices: int,
             # A plan with no priced collectives has nothing to compress:
             # the re-pricing could only tie (which fidelity wins) or add
             # overhead, so the variants are skipped, not enumerated.
-            if cost.coll_ratio <= 0.0 or not cost.memory_feasible:
-                continue
-            for dt in ("bfloat16", "int8"):
-                ccost = Evaluator(topo, comm_dtype=dt).run(
+            if cost.coll_ratio > 0.0 and cost.memory_feasible:
+                for dt in ("bfloat16", "int8"):
+                    ccost = Evaluator(topo, comm_dtype=dt).run(
+                        graph, strategies, num_micro_batches)
+                    out.append({"kind": "spmd", "topology": topo,
+                                "cost": ccost, "strategies": strategies,
+                                "comm_dtype": dt})
+            # ZeRO modifier (arXiv:2004.13336): every DP-bearing proposal
+            # re-priced with the weight update sharded over the data axis.
+            # Deliberately NOT gated on the fidelity plan's memory
+            # feasibility — the binding scenario is exactly a fidelity
+            # plan whose replicated optimizer state does not fit, and an
+            # infeasible fidelity keys to inf so ZeRO wins strictly.
+            dp = next((sz for nm, sz in topo.device_axes()
+                       if nm == "data" and sz > 1), 1)
+            if dp > 1 and cost.coll_ratio > 0.0:
+                zcost = Evaluator(topo, zero=True).run(
                     graph, strategies, num_micro_batches)
                 out.append({"kind": "spmd", "topology": topo,
-                            "cost": ccost, "strategies": strategies,
-                            "comm_dtype": dt})
+                            "cost": zcost, "strategies": strategies,
+                            "zero": True})
+                for dt in ("bfloat16", "int8"):
+                    zc = Evaluator(topo, comm_dtype=dt, zero=True).run(
+                        graph, strategies, num_micro_batches)
+                    out.append({"kind": "spmd", "topology": topo,
+                                "cost": zc, "strategies": strategies,
+                                "comm_dtype": dt, "zero": True})
         except Exception as e:  # noqa: BLE001 — infeasible proposal
             observatory.record_prune("spmd", str(topo),
                                      "planning_exception", exc=e)
@@ -183,15 +204,27 @@ def seq_candidates(graph, n_devices: int,
                 graph.total_flops() / n_devices, spec)
             var_bytes = sum(_ab(v.aval) for v in graph.invars)
             act = estimate_peak_activation_bytes(graph) / n_devices
+            # Same optimizer-state charge the Evaluator applies to the
+            # rival SPMD candidates (grads = non-scalar outvars of the
+            # value_and_grad trace) — hand-priced candidates must not get
+            # the state for free in the same argmin.
+            from tepdist_tpu.parallel.performance_utils import (
+                OPT_STATE_FACTOR,
+            )
+            opt_bytes = OPT_STATE_FACTOR * sum(
+                _ab(ov.aval) for ov in graph.outvars
+                if getattr(ov.aval, "shape", ()))
             total = compute_t + comm
             budget = spec.hbm_gb * 1e9 * 0.9
+            peak = var_bytes + act + opt_bytes
             cost = Cost(
                 total_duration=total,
                 compute_efficiency=compute_t / total if total else 0.0,
                 coll_ratio=comm / total if total else 0.0,
                 bubble_ratio=0.0,
-                peak_bytes_per_device=var_bytes + act,
-                memory_feasible=var_bytes + act <= budget)
+                peak_bytes_per_device=peak,
+                memory_feasible=peak <= budget,
+                opt_state_bytes_per_device=opt_bytes)
             out.append({"kind": "spmd", "topology": topo, "cost": cost,
                         "enum_kind": "seq"})
         except Exception as e:  # noqa: BLE001 — infeasible proposal
@@ -215,9 +248,25 @@ def pipeline_candidates(loss_fn: Callable, params, example_batch,
     constants (mean denominators) were baked at batch/M, so only that
     micro size evaluates correctly (plan_pipeline's micro-shape trace
     contract)."""
+    import math
+
     from tepdist_tpu.parallel.evaluator import Evaluator
+    from tepdist_tpu.parallel.performance_utils import (
+        OPT_STATE_FACTOR,
+        PerfUtils,
+        chip_spec,
+    )
     from tepdist_tpu.parallel.pipeline import plan_pipeline
     from tepdist_tpu.runtime.execution_plan import build_pipeline_task_dag
+
+    # Stage owners hold their stage's params + optimizer state; the
+    # scheduler's activation/weight model never sees the optimizer, so
+    # pipeline candidates carry the state charge explicitly (per stage
+    # ~ total/S, divided over the intra-stage TP axis where present).
+    import numpy as _np
+    param_bytes = float(sum(
+        math.prod(l.shape) * _np.dtype(l.dtype).itemsize
+        for l in jax.tree_util.tree_leaves(params)))
 
     out: List[Dict[str, Any]] = []
     for S in (2, 4, 8, 16):
@@ -275,12 +324,30 @@ def pipeline_candidates(loss_fn: Callable, params, example_batch,
                             if n.task_type == TaskType.COMPUTE:
                                 n.flops = (n.flops / tp
                                            + comm_s[n.stage] / sec_per_flop)
-                    cost = Evaluator(
-                        MeshTopology([("stage", S)])).run_pipeline(dag)
+                    ev = Evaluator(MeshTopology([("stage", S)]))
+                    stage_state = OPT_STATE_FACTOR * param_bytes / (S * tp)
+                    cost = ev.run_pipeline(dag,
+                                           opt_state_bytes=stage_state)
                     out.append(
                         {"kind": "pipeline", "num_stages": S,
                          "num_micro_batches": M, "intra_tp": tp,
                          "placement": "blocked", "cost": cost})
+                    # ZeRO variant: the stage's weight update sharded over
+                    # the intra-stage DP replicas (per//tp of them). NOT
+                    # gated on fidelity feasibility — the binding case is
+                    # a stage whose replicated optimizer state won't fit.
+                    dp = per // tp
+                    if dp > 1:
+                        zs = PerfUtils.zero_update_cost(
+                            param_bytes / (S * tp), dp, "", chip_spec())
+                        zcost = ev.run_pipeline(
+                            dag, opt_state_bytes=stage_state, zero_dp=dp,
+                            zero_comm_s=zs)
+                        out.append(
+                            {"kind": "pipeline", "num_stages": S,
+                             "num_micro_batches": M, "intra_tp": tp,
+                             "placement": "blocked", "cost": zcost,
+                             "zero": True})
                     # Comm-dtype variants: the SAME stage cut with the
                     # cross-stage SEND/RECV (and any AR) payloads shrunk
                     # to the wire dtype — the scheduler prices the
@@ -291,18 +358,31 @@ def pipeline_candidates(loss_fn: Callable, params, example_batch,
                     comm_nodes = [n for n in dag.nodes
                                   if n.task_type in (_TT.SEND, _TT.RECV,
                                                      _TT.AR)]
-                    if not comm_nodes or not cost.memory_feasible:
+                    if not comm_nodes:
                         continue
                     for dt in ("bfloat16", "int8"):
                         for n in comm_nodes:
                             n.comm_dtype = dt
-                        ccost = Evaluator(
-                            MeshTopology([("stage", S)])).run_pipeline(dag)
-                        out.append(
-                            {"kind": "pipeline", "num_stages": S,
-                             "num_micro_batches": M, "intra_tp": tp,
-                             "placement": "blocked", "cost": ccost,
-                             "comm_dtype": dt})
+                        if cost.memory_feasible:
+                            ccost = ev.run_pipeline(
+                                dag, opt_state_bytes=stage_state)
+                            out.append(
+                                {"kind": "pipeline", "num_stages": S,
+                                 "num_micro_batches": M, "intra_tp": tp,
+                                 "placement": "blocked", "cost": ccost,
+                                 "comm_dtype": dt})
+                        if dp > 1:
+                            zs = PerfUtils.zero_update_cost(
+                                param_bytes / (S * tp), dp, dt,
+                                chip_spec())
+                            zc = ev.run_pipeline(
+                                dag, opt_state_bytes=stage_state,
+                                zero_dp=dp, zero_comm_s=zs)
+                            out.append(
+                                {"kind": "pipeline", "num_stages": S,
+                                 "num_micro_batches": M, "intra_tp": tp,
+                                 "placement": "blocked", "cost": zc,
+                                 "comm_dtype": dt, "zero": True})
                     for n in comm_nodes:
                         n.comm_dtype = ""
                 except Exception as e:  # noqa: BLE001
@@ -336,8 +416,16 @@ def pipeline_candidates(loss_fn: Callable, params, example_batch,
                 try:
                     dag, _ = build_pipeline_task_dag(
                         prog, [groups[s % G] for s in range(S)])
+                    # Each of the G groups owns S/G virtual stages' params
+                    # + optimizer state. (ZeRO variants of interleaved
+                    # placements are not enumerated: the chunk-alternating
+                    # schedule leaves no idle window for the update
+                    # collectives the blocked variants amortize.)
                     cost = Evaluator(
-                        MeshTopology([("stage", S)])).run_pipeline(dag)
+                        MeshTopology([("stage", S)])).run_pipeline(
+                            dag,
+                            opt_state_bytes=(OPT_STATE_FACTOR
+                                             * param_bytes / G))
                     out.append(
                         {"kind": "pipeline", "num_stages": S,
                          "num_micro_batches": M, "intra_tp": 1,
@@ -524,6 +612,15 @@ def comm_dtype_suffix(comm_dtype: str) -> str:
     return "@" + _COMM_DTYPE_SHORT.get(comm_dtype, comm_dtype)
 
 
+def zero_suffix(zero: bool) -> str:
+    """Render a candidate's ZeRO weight-update-sharding modifier as the
+    ``@zero`` config suffix — like :func:`comm_dtype_suffix`, the ONE
+    rendering shared by candidate_summary and the observatory's
+    candidate_config, so plan_diff joins fidelity and ZeRO variants of
+    the same config as distinct candidates."""
+    return "@zero" if zero else ""
+
+
 def candidate_summary(candidates, best=None) -> List[Dict[str, Any]]:
     """Wire/debug-friendly ranked table of explored candidates (reference:
     candidate strategy dumps, auto_parallel.cc:309-311)."""
@@ -536,6 +633,7 @@ def candidate_summary(candidates, best=None) -> List[Dict[str, Any]]:
                + (f" il/G={c['interleave_groups']}"
                   if c.get("placement") == "interleaved" else ""))
         cfg += comm_dtype_suffix(c.get("comm_dtype", ""))
+        cfg += zero_suffix(c.get("zero", False))
         cost = c["cost"]
         rows.append({
             "kind": c["kind"], "config": cfg,

@@ -77,6 +77,25 @@ COMM_DTYPE_RATIOS: Dict[str, float] = {
     "int8": 0.25,
 }
 
+# Optimizer-state bytes per gradient byte (ZeRO pricing, arXiv:2004.13336).
+# Adam keeps two fp32 moments per fp32 param, so the state is ~2x the
+# param/grad payload; SGD-with-momentum is 1x and plain SGD 0x, but the
+# planner prices the worst common case — over-estimating state for a
+# stateless optimizer only makes a feasible plan look tighter, never
+# flips a ranking between two candidates (both carry the same factor).
+OPT_STATE_FACTOR = 2.0
+
+
+def param_wire_dtype(comm_dtype: str) -> str:
+    """Wire dtype for the ZeRO updated-param all-gather under a comm-dtype
+    modifier. Gradients tolerate int8 fake-quant (stochastic rounding keeps
+    the expectation), but PARAMS quantized to int8 every step would
+    accumulate bias directly into the weights — so int8 plans gather params
+    at bf16, the asymmetry EQuARX also keeps."""
+    if comm_dtype == "int8":
+        return "bfloat16"
+    return comm_dtype
+
 
 def _calib():
     """The active calibration profile (telemetry/calibrate.py) or None.
@@ -204,6 +223,30 @@ class PerfUtils:
         ratio = COMM_DTYPE_RATIOS.get(comm_dtype, 1.0)
         return (cls.all_gather_cost(bytes_ * ratio, n, spec, over_dcn)
                 + cls.quantize_overhead(bytes_, comm_dtype, spec))
+
+    @classmethod
+    def zero_update_cost(cls, grad_bytes: float, dp: int, comm_dtype: str,
+                         spec: TpuChipSpec | None = None,
+                         over_dcn: bool = False) -> float:
+        """ZeRO-1 weight-update collectives over a DP axis of ``dp``
+        (arXiv:2004.13336): reduce-scatter the accumulated gradient, apply
+        on the local 1/dp shard, all-gather the updated params. Composes
+        with the comm-dtype modifier on BOTH collectives (grads at
+        ``comm_dtype``, params at :func:`param_wire_dtype`). Note
+        RS + AG at equal bytes = ring AR + one extra alpha sweep, so ZeRO
+        never wins on pure seconds — it wins by making optimizer state
+        1/dp per device (memory feasibility)."""
+        if dp <= 1:
+            return 0.0
+        rs_ratio = COMM_DTYPE_RATIOS.get(comm_dtype, 1.0)
+        ag_dtype = param_wire_dtype(comm_dtype)
+        ag_ratio = COMM_DTYPE_RATIOS.get(ag_dtype, 1.0)
+        return (cls.reduce_scatter_cost(grad_bytes * rs_ratio, dp, spec,
+                                        over_dcn)
+                + cls.quantize_overhead(grad_bytes, comm_dtype, spec)
+                + cls.all_gather_cost(grad_bytes * ag_ratio, dp, spec,
+                                      over_dcn)
+                + cls.quantize_overhead(grad_bytes, ag_dtype, spec))
 
     @classmethod
     def compressed_ppermute_cost(

@@ -39,6 +39,12 @@ class PipelineProgram:
     # build path; consumed by the task-dag builder (SEND/RECV tagging)
     # and the executor's gradient-accumulate payloads.
     comm_dtype: str = ""
+    # ZeRO weight-update sharding modifier: when True each stage's
+    # optimizer state is sharded over the intra-stage data axis
+    # (reduce-scatter grads, local apply, all-gather params). Set by the
+    # exploration winner; consumed by the executor, the task-dag builder
+    # and the fleet plan_meta.
+    zero: bool = False
 
     @property
     def stages(self):

@@ -204,6 +204,26 @@ def analyze_sync_free(
 # The decomposition (constructive form)
 # --------------------------------------------------------------------------
 
+def _zero_pad_flat(x, dp: int):
+    """Flatten ``x`` and zero-pad to a multiple of ``dp`` — the canonical
+    ZeRO shard layout: contiguous 1/dp rows of the padded flat vector."""
+    flat = x.reshape(-1)
+    pad = (-flat.size) % dp
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
+def zero_pad_params(params, zero_dp: int):
+    """Params tree re-laid-out as padded flat leaves (``_zero_pad_flat``).
+    ``optimizer.init`` on this tree yields the GLOBAL optimizer state for
+    the explicit ZeRO GA path: each moment leaf is a flat (dp*chunk,)
+    vector whose contiguous 1/dp rows are one replica's shard — pass it
+    into shard_map with ``P(axis)`` partitioning on the leaves."""
+    return jax.tree_util.tree_map(
+        lambda p: _zero_pad_flat(p, zero_dp), params)
+
+
 def build_ga_step(
     grad_fn: Callable,
     apply_fn: Callable,
@@ -211,6 +231,8 @@ def build_ga_step(
     batch_argnums: Tuple[int, ...] = (1,),
     batch_dim: int = 0,
     comm_dtype: str = "",
+    zero_dp: int = 0,
+    zero_axis_name: str = "",
 ) -> Callable:
     """Construct the sync-free GA training step (reference decomposition
     ENTRY -> {GAINIT, CG, GA, AG} as one scanned program).
@@ -227,6 +249,20 @@ def build_ga_step(
         FP16_COMM path); "int8" = chunk-scale fake-quant with STOCHASTIC
         rounding (parallel/quantize.py) so the quantization error is
         zero-mean across steps.
+      zero_dp / zero_axis_name: the explicit ZeRO-1 weight-update path
+        (arXiv:2004.13336) for named-axis (shard_map) contexts: the
+        accumulated gradient is reduce-scattered over ``zero_axis_name``
+        (``lax.psum_scatter`` — the apply sees the cross-replica SUM on
+        its local 1/dp shard; fold your own 1/dp for mean semantics),
+        ``apply_fn`` runs on the padded-flat param/grad SHARDS (init the
+        optimizer on :func:`zero_pad_params`), and the updated params
+        all-gather back to full shapes. Composes with ``comm_dtype``:
+        the reduce-scatter wire follows the gradient dtype, the param
+        all-gather uses :func:`~tepdist_tpu.parallel.performance_utils.
+        param_wire_dtype` (bf16 cap — params are never int8-quantized).
+        The single-jit SPMD path does NOT use this: there the planner
+        realizes ZeRO by sharding the optimizer-state invars and GSPMD
+        emits the equivalent collectives (auto_parallel ``zero_invars``).
 
     Returns ``step(params, opt_state, *batch) -> (mean_loss, params, opt)``.
     """
@@ -237,6 +273,47 @@ def build_ga_step(
     # through chunk scales instead.
     compress = ServiceEnv.get().fp16_comm or comm_dtype == "bfloat16"
     int8 = comm_dtype == "int8"
+    zero = zero_dp > 1 and bool(zero_axis_name)
+
+    def zero_apply(params, opt_state, grads):
+        """RS -> local shard apply -> AG (the ZeRO-1 update). ``grads``
+        are full-shape accumulated means; ``opt_state`` is the LOCAL
+        shard state (flat-leaf moments under shard_map P(axis))."""
+        from tepdist_tpu.parallel.performance_utils import param_wire_dtype
+
+        def rs(g):
+            flat = _zero_pad_flat(g, zero_dp)
+            if compress and jnp.issubdtype(flat.dtype, jnp.floating):
+                # The bf16 wire: psum_scatter reduces at the wire dtype,
+                # the shard dequantizes back (int8 grads were already
+                # fake-quanted per micro batch in the scan).
+                return lax.psum_scatter(
+                    flat.astype(jnp.bfloat16), zero_axis_name,
+                    scatter_dimension=0, tiled=True).astype(g.dtype)
+            return lax.psum_scatter(flat, zero_axis_name,
+                                    scatter_dimension=0, tiled=True)
+
+        idx = lax.axis_index(zero_axis_name)
+
+        def shard(p):
+            flat = _zero_pad_flat(p, zero_dp)
+            chunk = flat.size // zero_dp
+            return lax.dynamic_slice_in_dim(flat, idx * chunk, chunk)
+
+        g_shards = jax.tree_util.tree_map(rs, grads)
+        p_shards = jax.tree_util.tree_map(shard, params)
+        new_shards, opt_state = apply_fn(p_shards, opt_state, g_shards)
+        ag_bf16 = param_wire_dtype(comm_dtype) == "bfloat16"
+
+        def ag(s, p):
+            if ag_bf16 and jnp.issubdtype(s.dtype, jnp.floating):
+                s = s.astype(jnp.bfloat16)
+            full = lax.all_gather(s, zero_axis_name, tiled=True)
+            return full.astype(p.dtype)[:p.size].reshape(p.shape)
+
+        return jax.tree_util.tree_map(ag, new_shards, params), opt_state
+
+    do_apply = zero_apply if zero else apply_fn
 
     def maybe_compress(g, micro_index):
         if int8:
@@ -258,7 +335,7 @@ def build_ga_step(
                     if hasattr(g, "astype") else g,
                     maybe_compress(grads, jnp.zeros((), jnp.uint32)),
                     params)
-            params, opt_state = apply_fn(params, opt_state, grads)
+            params, opt_state = do_apply(params, opt_state, grads)
             return loss, params, opt_state
         return step1
 
@@ -297,8 +374,8 @@ def build_ga_step(
             body, (acc0, jnp.zeros(())), (micro_index, micro_batches))
         inv = 1.0 / num_micro_batches
         grads = jax.tree_util.tree_map(lambda g: g * inv, acc)
-        # AG: apply-gradients slice.
-        params, opt_state = apply_fn(params, opt_state, grads)
+        # AG: apply-gradients slice (or the ZeRO RS->apply->AG update).
+        params, opt_state = do_apply(params, opt_state, grads)
         return loss_sum * inv, params, opt_state
 
     return step

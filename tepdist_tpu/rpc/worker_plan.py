@@ -272,6 +272,11 @@ class WorkerPlan:
         # DispatchPlan's plan_meta (master + every worker agree on it).
         self._wire_dtype = (_env.tepdist_wire_dtype
                             or plan_meta.get("comm_dtype", "") or None)
+        # ZeRO modifier from the winner's plan_meta: with >1 local data
+        # replica this worker shards its stage's optimizer state over its
+        # intra mesh and the apply jit runs on local shards (single-device
+        # workers carry the flag but have nothing to shard).
+        self._zero = bool(plan_meta.get("zero")) and self._intra is not None
         # Peer-visible address of our transfer server: the bind address is
         # "[::]:port" — advertise our cluster ip instead.
         self._xfer_addr = None
@@ -678,6 +683,29 @@ class WorkerPlan:
             raise KeyError(f"no param index map for remote stage {t}")
         return t_gis
 
+    def _zero_shard_state(self, state):
+        """ZeRO: split each non-scalar optimizer-state leaf over the local
+        intra mesh on its first dp-divisible dim (replicated otherwise);
+        identity when the plan is not a ZeRO winner."""
+        if not self._zero:
+            return list(state)
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = self._intra[0].mesh
+        dp = int(mesh.shape["intra"])
+        out = []
+        for v in state:
+            shape = tuple(getattr(v, "shape", ()))
+            for d, n in enumerate(shape):
+                if n >= dp and n % dp == 0:
+                    parts = [None] * len(shape)
+                    parts[d] = "intra"
+                    sh = NamedSharding(mesh, PartitionSpec(*parts))
+                    if getattr(v, "sharding", None) != sh:
+                        v = jax.device_put(v, sh)
+                    break
+            out.append(v)
+        return out
+
     def _apply(self, s: int, acc, extras=None) -> None:
         """Apply gradients for params OWNED by stage ``s`` only, summing
         shared params' contributions from other stages' accumulators. Uses
@@ -731,13 +759,15 @@ class WorkerPlan:
             cur = getattr(self, "opt_states", {}).get(s)
             if cur is None:
                 cur = list(stage.opt_init(*params_flat))
-            state = tuple(cur)
+            state = tuple(self._zero_shard_state(cur))
         else:
             state = ()
         eaccs = [tuple(jnp.asarray(g) for g in extras[t]) for t in contrib]
         new_params, new_state = self._apply_jit[cache_key](
             tuple(params_flat), state, tuple(acc), *eaccs)
         if stage.opt_update is not None:
-            self._staged_opt[s] = list(new_state)
+            # Re-pin ZeRO shards (the jit may replicate outputs) so the
+            # per-device saving survives across steps.
+            self._staged_opt[s] = self._zero_shard_state(new_state)
         for gi, p in zip(owned, new_params):
             self._staged_vars[gi] = p

@@ -70,12 +70,19 @@ class AsyncSaveHandle:
 
 class CheckpointUtil:
     def __init__(self, directory: str, max_to_keep: int = 5,
-                 own_manifest: bool = True):
+                 own_manifest: bool = True, shard_addressable: bool = False):
         """``own_manifest=False`` makes this writer shard-only: it never
-        touches the keep-queue or prunes (non-zero workers)."""
+        touches the keep-queue or prunes (non-zero workers).
+
+        ``shard_addressable=True`` writes per-shard entries (+ the index
+        sidecar) even for FULLY ADDRESSABLE arrays that are actually
+        sharded — the ZeRO save path: single-process optimizer-state
+        shards stay per-shard on disk, so ``restore_resharded`` can land
+        them on any DP width without ever materializing the full array."""
         self.dir = directory
         self.max_to_keep = max_to_keep
         self.own_manifest = own_manifest
+        self.shard_addressable = shard_addressable
         self._async_lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
 
@@ -116,6 +123,16 @@ class CheckpointUtil:
 
         return np.asarray(jax.device_get(value))
 
+    @staticmethod
+    def _distinct_extents(v) -> int:
+        """Number of DISTINCT shard extents of a jax Array (1 for
+        replicated/single-device placements)."""
+        seen = set()
+        for sh in v.addressable_shards:
+            seen.add(tuple(sl.indices(dim)[:2]
+                           for sl, dim in zip(sh.index, v.shape)))
+        return len(seen)
+
     def _stream_entries(self, variables: Dict[str, Any]
                         ) -> Iterable[Tuple[str, np.ndarray, Dict]]:
         """Yield (npz key, host array, sidecar meta) ONE VARIABLE AT A
@@ -126,7 +143,10 @@ class CheckpointUtil:
         import jax
 
         for k, v in variables.items():
-            if not isinstance(v, jax.Array) or v.is_fully_addressable:
+            as_shards = isinstance(v, jax.Array) and (
+                not v.is_fully_addressable
+                or (self.shard_addressable and self._distinct_extents(v) > 1))
+            if not as_shards:
                 yield k, self._fetch(v), {}
                 continue
             seen = set()

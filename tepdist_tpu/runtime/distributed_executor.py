@@ -247,6 +247,10 @@ class DistributedPipelineSession:
                 # host_push frames encode at this dtype when the local
                 # TEPDIST_WIRE_DTYPE knob is unset.
                 "comm_dtype": getattr(prog, "comm_dtype", "") or "",
+                # ZeRO modifier: workers with >1 local data replica shard
+                # their stage's optimizer state and bracket the apply
+                # with reduce-scatter/all-gather.
+                "zero": bool(getattr(prog, "zero", False)),
             }
             # client.call attaches the idempotency token: a retried
             # DispatchPlan whose original landed (response lost) must not

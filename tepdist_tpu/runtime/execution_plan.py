@@ -274,5 +274,13 @@ def build_pipeline_task_dag(
             if n.task_type in (TaskType.SEND, TaskType.RECV, TaskType.AR):
                 n.comm_dtype = cd
 
+    # ZeRO winners: tag the weight-update tasks so executors shard the
+    # per-stage optimizer state over intra-stage data replicas
+    # (reduce-scatter grads, local apply, all-gather params).
+    if getattr(prog, "zero", False):
+        for n in dag.nodes:
+            if n.task_type in (TaskType.APPLY, TaskType.AR):
+                n.zero = True
+
     dag.validate()
     return dag, maps

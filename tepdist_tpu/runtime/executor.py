@@ -150,6 +150,11 @@ class PipelineExecutable:
         self._micro_rows = micro_rows
         self.intra_dp = (intra_stage_dp and dp > 1 and micro_rows is not None
                          and micro_rows % dp == 0)
+        # ZeRO weight-update sharding (the exploration winner's modifier):
+        # each stage's optimizer state shards over its intra-stage data
+        # replicas; the apply jit then runs on local shards and GSPMD
+        # emits the reduce-scatter/all-gather bracket (arXiv:2004.13336).
+        self.zero = bool(getattr(prog, "zero", False)) and dp > 1
         for s in range(S):
             devs = devices_of_stage[s]
             self.stage_devices.append(tuple(d.id for d in devs))
@@ -535,6 +540,39 @@ class PipelineExecutable:
                        for i in sorted(self.param_owner)
                        if self.param_owner[i] == s}
                 self.opt_states[s] = self.optimizer.init(sub)
+                if self.zero:
+                    self.opt_states[s] = self._shard_opt_state(
+                        s, self.opt_states[s])
+
+    def _zero_opt_sharding(self, s: int, val, i: Optional[int] = None):
+        """ZeRO: the moment mirroring param ``i`` shards over the intra
+        axis on the first dim its planned (TP) spec leaves free and dp
+        divides; scalars and indivisible leaves stay replicated."""
+        mesh = self.stage_meshes[s]
+        dp = int(mesh.shape["intra"])
+        shape = tuple(getattr(val, "shape", ()))
+        base = self._param_sharding.get((s, i)) if i is not None else None
+        parts: List[Any] = list(base.spec) if base is not None else []
+        parts += [None] * (len(shape) - len(parts))
+        for d, n in enumerate(shape):
+            if parts[d] is None and n >= dp and n % dp == 0:
+                parts[d] = "intra"
+                return NamedSharding(mesh, PartitionSpec(*parts))
+        return base or self.stage_shardings[s]
+
+    def _shard_opt_state(self, s: int, st):
+        """Re-place stage ``s``'s optimizer state on its ZeRO shardings
+        (no-op for leaves already placed there)."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(st)
+        new = []
+        for p, v in flat:
+            i = self._leaf_owner_index(p)
+            if i is not None and getattr(v, "ndim", 0) >= 1:
+                sh = self._zero_opt_sharding(s, v, i)
+                if getattr(v, "sharding", None) != sh:
+                    v = jax.device_put(v, sh)
+            new.append(v)
+        return jax.tree_util.tree_unflatten(treedef, new)
 
     def _stage_param(self, s: int, i: int):
         """Param value for stage ``s``: owner's copy, broadcast if shared.
@@ -657,8 +695,11 @@ class PipelineExecutable:
                 # for and force an apply-jit recompile).
                 sh = (self._param_sharding.get((s, i))
                       if i is not None else None) or self.stage_shardings[s]
-                new.append(jax.device_put(
-                    by_key[jax.tree_util.keystr(p)], sh))
+                val = by_key[jax.tree_util.keystr(p)]
+                if (self.zero and i is not None
+                        and getattr(val, "ndim", 0) >= 1):
+                    sh = self._zero_opt_sharding(s, val, i)
+                new.append(jax.device_put(val, sh))
             self.opt_states[s] = jax.tree_util.tree_unflatten(treedef, new)
 
     # ------------------------------------------------------------------
@@ -864,6 +905,11 @@ class PipelineExecutable:
                  for t in contrib] if contrib else []
         new_params, self.opt_states[s] = self._apply_jit[key](
             params, self.opt_states[s], acc, *eaccs)
+        if self.zero:
+            # The apply jit is free to replicate its outputs; re-pin the
+            # state shards so the memory saving survives across steps
+            # (no-op when GSPMD already kept them sharded).
+            self.opt_states[s] = self._shard_opt_state(s, self.opt_states[s])
         for i in owned:
             val = new_params[i]
             sh = self._param_sharding.get((s, i))

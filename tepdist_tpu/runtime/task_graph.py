@@ -77,6 +77,10 @@ class TaskNode:
     # scheduler prices tagged nodes with the compressed collective cost
     # and the distributed runtime encodes their frames at this dtype.
     comm_dtype: str = ""
+    # ZeRO modifier on weight-update tasks: the owning stage's optimizer
+    # state is sharded over its intra-stage data replicas, so APPLY runs
+    # on a local shard bracketed by reduce-scatter/all-gather.
+    zero: bool = False
     parents: List[int] = dataclasses.field(default_factory=list)
     children: List[int] = dataclasses.field(default_factory=list)
     # Task ids whose outputs may be freed once this task completes

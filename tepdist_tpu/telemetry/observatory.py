@@ -93,9 +93,13 @@ def candidate_config(c: Dict[str, Any]) -> str:
     """Stable config string for a candidate dict — the alignment key
     plan_diff joins two reports on (same rendering as
     ``exploration.candidate_summary``)."""
-    from tepdist_tpu.parallel.exploration import comm_dtype_suffix
+    from tepdist_tpu.parallel.exploration import (
+        comm_dtype_suffix,
+        zero_suffix,
+    )
 
-    suffix = comm_dtype_suffix(c.get("comm_dtype", ""))
+    suffix = (comm_dtype_suffix(c.get("comm_dtype", ""))
+              + zero_suffix(c.get("zero", False)))
     if c["kind"] == "spmd":
         return str(c["topology"]) + suffix
     return (f"S={c['num_stages']} M={c['num_micro_batches']}"
@@ -121,6 +125,10 @@ def cost_terms(cost: Any) -> Dict[str, Any]:
         "bubble_ratio": float(cost.bubble_ratio),
         "peak_bytes_per_device": float(cost.peak_bytes_per_device),
         "memory_feasible": bool(cost.memory_feasible),
+        # getattr: Cost objects round-tripped from pre-ZeRO fixture JSONs
+        # may predate the field.
+        "opt_state_bytes_per_device": float(
+            getattr(cost, "opt_state_bytes_per_device", 0.0) or 0.0),
     }
 
 

@@ -174,3 +174,35 @@ def test_restore_sharded_onto_different_mesh(tmp_path):
     got = np.asarray(tree[0])
     np.testing.assert_array_equal(got, full)
     assert tree[0].sharding.is_equivalent_to(tgt, 2)
+
+
+def test_zero_opt_state_reshards_across_dp_widths(tmp_path):
+    """The ZeRO checkpoint contract end-to-end: optimizer-state moments
+    saved as dp=2 shards (shard_addressable — the save path an @zero
+    winner selects) land on a dp=4 mesh AND come back whole for a dp=1
+    restore, without ever materializing the full array on the reshard
+    path."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs >= 4 devices")
+    full = np.arange(32, dtype=np.float32)
+    mesh2 = Mesh(np.array(devs[:2]), ("data",))
+    mu = jax.device_put(full, NamedSharding(mesh2, P("data")))
+    util = CheckpointUtil(str(tmp_path), shard_addressable=True)
+    util.save(7, {"opt.mu": mu})
+
+    # Widen: dp=2 -> dp=4 destination extents, each an 8-row slice.
+    dsts = [[[i * 8, (i + 1) * 8]] for i in range(4)]
+    out, step = util.restore_resharded({"opt.mu": dsts})
+    assert step == 7
+    for d, got in zip(dsts, out["opt.mu"]):
+        (lo, hi), = d
+        np.testing.assert_array_equal(got, full[lo:hi])
+
+    # Shrink to unsharded: the plain restore reassembles the global
+    # array from the per-shard entries.
+    whole, _ = util.restore()
+    np.testing.assert_array_equal(whole["opt.mu"], full)

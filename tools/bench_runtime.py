@@ -135,13 +135,18 @@ def bench_collective_pipeline(devices=None, batch=None, seq=None) -> float:
     return _timed_ms_per_step(step_once)
 
 
-def spawn_protocol_fleet():
+def spawn_protocol_fleet(zero: bool = False):
     """Spawn the pinned protocol's worker fleet (one server process per
     stage, 1 device each) and build the DistributedPipelineSession over
     it. Returns (session, tokens, worker_procs); the caller owns
     teardown (SIGKILL the procs). Shared by the fleet benchmark line and
     tools/fleet_overhead_probe.py so both measure the SAME fleet
-    configuration."""
+    configuration.
+
+    ``zero`` tags the program with the ZeRO weight-update modifier
+    before the session ships plan_meta, so every worker runs the
+    sharded-optimizer apply path (a no-op reshard at 1 device/stage —
+    the arm prices the plumbing, not the sharding)."""
     import socket
     import subprocess
 
@@ -188,6 +193,8 @@ def spawn_protocol_fleet():
         prog = plan_pipeline(
             lambda p, t: gpt2.loss_fn(p, t, cfg), STAGES, MICRO, params,
             tokens)
+        if zero:
+            prog.zero = True
         cluster = ClusterSpec([
             WorkerSpec("127.0.0.1", p, [0], task_index=i)
             for i, p in enumerate(ports)])
@@ -208,7 +215,7 @@ def spawn_protocol_fleet():
 _FLEET_TRACE_PATH = [None]
 
 
-def bench_two_worker_fleet(wire_dtype: str = "") -> float:
+def bench_two_worker_fleet(wire_dtype: str = "", zero: bool = False) -> float:
     """SAME protocol config over a 2-PROCESS fleet (one server process
     per stage, 1 device each): the multi-worker task-graph path on its
     backend-default transport — host push on the CPU fabric (a "device"
@@ -218,7 +225,10 @@ def bench_two_worker_fleet(wire_dtype: str = "") -> float:
     ``wire_dtype`` runs the compressed-wire arm: TEPDIST_WIRE_DTYPE is
     set in os.environ BEFORE the fleet spawns (workers inherit it; the
     wire dtype latches at worker/session construction) and in the
-    master's ServiceEnv for its dispatch envelopes."""
+    master's ServiceEnv for its dispatch envelopes.
+
+    ``zero`` runs the ZeRO arm: plan_meta ships ``zero=True`` so every
+    worker takes the sharded-optimizer apply path."""
     import signal
 
     from tepdist_tpu.core.service_env import ServiceEnv
@@ -230,7 +240,7 @@ def bench_two_worker_fleet(wire_dtype: str = "") -> float:
         os.environ["TEPDIST_WIRE_DTYPE"] = wire_dtype
         env.set("TEPDIST_WIRE_DTYPE", wire_dtype)
     try:
-        sess, tokens, procs = spawn_protocol_fleet()
+        sess, tokens, procs = spawn_protocol_fleet(zero=zero)
     finally:
         if wire_dtype:
             if prev_env is None:
@@ -363,6 +373,11 @@ def run() -> dict:
         fleet_c_ms = bench_two_worker_fleet(wire_dtype="bfloat16")
     except Exception as e:  # noqa: BLE001
         err["two_worker_fleet_compressed"] = repr(e)
+    fleet_z_ms = None
+    try:
+        fleet_z_ms = bench_two_worker_fleet(zero=True)
+    except Exception as e:  # noqa: BLE001
+        err["two_worker_fleet_zero"] = repr(e)
     task_l = coll_l = None
     try:
         task_l = bench_task_graph(devices, BATCH_L, SEQ_L)
@@ -407,6 +422,12 @@ def run() -> dict:
         "wire_compression_speedup":
             None if not (fleet_ms and fleet_c_ms)
             else round(fleet_ms / fleet_c_ms, 4),
+        # SAME fleet with the ZeRO weight-update modifier in plan_meta:
+        # every worker reshards optimizer state over its intra axis each
+        # apply (a no-op placement at 1 device/stage, so any gap over
+        # two_worker_fleet_ms is pure plumbing overhead).
+        "two_worker_fleet_zero_ms":
+            None if fleet_z_ms is None else round(fleet_z_ms, 2),
         # Amortization check (BATCH_L x SEQ_L = b128 x s64, ~32x per-task
         # compute): the per-step dispatch gap should shrink toward 1.0.
         "task_graph_large_ms": None if task_l is None else round(task_l, 2),

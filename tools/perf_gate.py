@@ -50,9 +50,11 @@ DEFAULT_HISTORY = os.path.join(HERE, "bench_history.jsonl")
 # them from the ledger gap table). --keys widens or narrows the
 # watchlist; recording always keeps everything.
 DEFAULT_KEYS = ("two_worker_fleet_ms", "two_worker_fleet_compressed_ms",
+                "two_worker_fleet_zero_ms",
                 "serving_tok_s", "paged_capacity_x", "plan_verify_ms",
                 "rpc_orchestration_ms", "serde_ms",
                 "explore_report_ms", "quantized_ar_x",
+                "zero_opt_mem_x",
                 "host_push_bytes_per_step")
 
 _HIGHER_BETTER_SUFFIXES = ("tok_s", "_x", "_per_s", "_rate", "_speedup")

@@ -63,16 +63,21 @@ def print_table(report: Dict[str, Any], top: int = 0) -> None:
         print(f"excluded kinds: {report['excluded_kinds']}")
     print(f"  {'rank':>4} {'kind':>8} {'config':<34} {'total_s':>11} "
           f"{'compute_s':>11} {'coll_s':>10} {'bubble_s':>10} "
-          f"{'mem':>4}")
+          f"{'opt_MB':>7} {'mem':>4}")
     rows = cands[:top] if top else cands
     for c in rows:
         t = c["cost"]
         mark = "  <== winner" if c.get("winner") else ""
         if c.get("involuntary_remats"):
             mark += f" [{c['involuntary_remats']} involuntary remat(s)]"
+        # Per-device optimizer-state bytes (ISSUE 14); pre-ZeRO reports
+        # lack the term — show a dash, not 0 (0 would read as measured).
+        opt = t.get("opt_state_bytes_per_device")
+        opt_s = "-" if opt is None else f"{opt / 1e6:.3f}"
         print(f"  {c['rank']:>4} {c['kind']:>8} {c['config']:<34} "
               f"{t['total_s']:>11.4e} {t['compute_s']:>11.4e} "
               f"{t['coll_s']:>10.3e} {t['bubble_s']:>10.3e} "
+              f"{opt_s:>7} "
               f"{'ok' if t['memory_feasible'] else 'OOM':>4}{mark}")
     if top and len(cands) > top:
         print(f"  ... {len(cands) - top} more candidate(s)")
