@@ -24,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import statistics
 import sys
 import time
 import traceback
@@ -597,19 +598,31 @@ def bench_trace_overhead() -> dict:
         disabled_ns = (time.perf_counter_ns() - t0) / n
 
         tmp.enabled = True
-        t0 = time.perf_counter_ns()
-        for _ in range(n):
-            with _trace.span("bench", cat="bench"):
-                pass
-        enabled_ns = (time.perf_counter_ns() - t0) / n
+        # Min of repeated loops: on a tight loop, host noise is strictly
+        # additive (deschedules, frequency dips only ever ADD time), so
+        # the minimum is the estimator of the true per-span cost — and
+        # unlike the median it is stable across processes on a loaded
+        # host, which the perf-gate history band depends on.  The gated
+        # budget is <= 600 ns/span (ISSUE 16).
+        reps = []
+        for _ in range(5):
+            t0 = time.perf_counter_ns()
+            for _ in range(n):
+                with _trace.span("bench", cat="bench"):
+                    pass
+            reps.append((time.perf_counter_ns() - t0) / n)
+            tmp.clear()
+        enabled_ns = min(reps)
     finally:
         _trace._TRACER = prev
     return {
         "metric": "trace_overhead",
         "value": round(disabled_ns, 1),
         "unit": "ns/span disabled",
+        "trace_enabled_ns_per_span": round(enabled_ns, 1),
         "enabled_ns_per_span": round(enabled_ns, 1),
-        "spans_recorded_enabled": len(tmp),
+        "native_core": tmp._core is not None,
+        "gate_below_600ns": bool(enabled_ns <= 600.0),
         "noop_fast_path": True,
     }
 
@@ -646,13 +659,33 @@ def bench_plan_verify(rounds: int = 20) -> dict:
     }
 
 
-def bench_ledger_overhead(steps: int = 6, warmup: int = 2) -> dict:
+def bench_ledger_overhead(ab_pairs: int = 5, null_pairs: int = 3,
+                          window_steps: int = 10, warmup: int = 6) -> dict:
     """RPC-ledger + flight-recorder cost on the two-worker in-proc fleet
-    fixture: min-of-steps wall with both instruments OFF vs ON (tracing
-    off in both arms, isolating the PR 9 hooks). The acceptance bound is
-    <= 2% of step time enabled; disabled is the ``active() is None``
-    branch-only fast path, so ``disabled_noop`` asserts it stays a no-op
-    rather than timing noise."""
+    fixture, measured with the ISSUE 16 noise-guarded methodology.
+
+    The naive A/B (one OFF run, one ON run, compare mins) cannot resolve
+    a ~30 us effect on a ~4 ms multi-threaded step on a drifting host: an
+    OFF-vs-OFF null experiment on this class of machine shows the same
+    magnitude of "overhead" as a real ON run.  So the bench measures
+    three things on ONE warm session and decides which is trustworthy:
+
+    1. NULL CALIBRATION — ``null_pairs`` interleaved OFF/OFF window pairs
+       (min-of-steps per window, alternating order).  The median absolute
+       pair delta is the host's A/B noise floor for this workload.
+    2. A/B — ``ab_pairs`` interleaved OFF/ON pairs, same estimator.
+    3. PER-OP ACCOUNTING — record/scope volumes counted from a drained
+       enabled step, times per-op in-situ costs measured in a tight loop
+       (the full hook pattern: clocks + the bound native record call, and
+       the full scope/hint context lifecycle).
+
+    ``value`` is the A/B median when it clears the measured noise floor
+    (a quiet host measures directly), else the per-op accounting total
+    (a noisy host reports the physically attributable cost rather than a
+    random draw from its own jitter).  Both are always reported, with the
+    methodology stamped.  The acceptance bound is <= 2% of step time;
+    disabled stays the ``active() is None`` branch-only fast path
+    (``disabled_noop`` asserts it)."""
     import optax
 
     from tepdist_tpu import telemetry
@@ -679,44 +712,164 @@ def bench_ledger_overhead(steps: int = 6, warmup: int = 2) -> dict:
     y = jax.random.normal(keys[5], (8, 16))
 
     telemetry.trace.configure(enabled=False)
+    led = ledger.ledger()
 
-    def fleet_min_ms(led_on: bool) -> float:
-        ledger.configure(enabled=led_on)
-        flight.configure(enabled=led_on)
-        prog = plan_pipeline(loss_fn, 2, 2, params, x, y)
-        cluster, _serv = make_inproc_cluster(2, jax.devices()[:1])
-        sess = DistributedPipelineSession(prog, cluster,
-                                          optimizer=optax.sgd(1e-2))
-        try:
-            sess.load_variables(params)
-            for _ in range(warmup):
-                sess.step(x, y)
+    prog = plan_pipeline(loss_fn, 2, 2, params, x, y)
+    cluster, _serv = make_inproc_cluster(2, jax.devices()[:1])
+    sess = DistributedPipelineSession(prog, cluster,
+                                      optimizer=optax.sgd(1e-2))
+    try:
+        sess.load_variables(params)
+        for _ in range(warmup):
+            sess.step(x, y)
+
+        def window_ms(on: bool) -> float:
+            ledger.configure(enabled=on)
+            flight.configure(enabled=on)
             best = float("inf")
-            for _ in range(steps):
+            for _ in range(window_steps):
                 t0 = time.perf_counter()
                 sess.step(x, y)
                 best = min(best, time.perf_counter() - t0)
-        finally:
-            sess.close()
-            close_inproc_cluster(cluster)
-        return best * 1e3
+            led.clear()
+            return best * 1e3
 
-    try:
-        off_ms = fleet_min_ms(False)
+        # 1. Null calibration: both windows OFF — any nonzero delta is
+        # host noise, and its magnitude is the floor below which a real
+        # A/B delta is unreadable.
+        null_pcts = []
+        for p in range(null_pairs):
+            a = window_ms(False)
+            b = window_ms(False)
+            null_pcts.append((b - a) / a * 100.0 if a else 0.0)
+        noise_floor = statistics.median(abs(v) for v in null_pcts)
+
+        ledger.configure(enabled=False)
         noop = ledger.active() is None
-        on_ms = fleet_min_ms(True)
+
+        # 2. Paired A/B, ABBA order so secular drift cancels per pair.
+        ab_pcts = []
+        off_mins = []
+        for p in range(ab_pairs):
+            if p % 2 == 0:
+                off = window_ms(False)
+                on = window_ms(True)
+            else:
+                on = window_ms(True)
+                off = window_ms(False)
+            off_mins.append(off)
+            ab_pcts.append((on - off) / off * 100.0 if off else 0.0)
+        ab_median = statistics.median(ab_pcts)
+        off_ms = statistics.median(off_mins)
+
+        # 3. Per-op accounting: volumes from one drained enabled window,
+        # costs from tight in-situ loops.
+        ledger.configure(enabled=True)
+        led.clear()
+        acct_steps = 4
+        for _ in range(acct_steps):
+            sess.step(x, y)
+        recs, _cats, _lost, _names = led._drain()
+        led.clear()
+        kind_count = [0] * 8
+        for r in recs:
+            kind_count[r[0]] += 1
+        # Wire hooks (PACK/UNPACK/ENCODE/DECODE/RETRY) each cost two
+        # clock reads plus one bound record call; CALL/HANDLER/WINDOW
+        # records come from scope objects whose lifecycle includes their
+        # exit record.  Step hints leave no record — sites fire about
+        # once per dispatch RPC, costed at the measured hint lifecycle.
+        wire_per_step = sum(kind_count[i] for i in (0, 1, 2, 3, 6)) \
+            / acct_steps
+        scopes_per_step = sum(kind_count[i] for i in (4, 5, 7)) / acct_steps
+        calls_per_step = kind_count[4] / acct_steps
+
+        # Min-of-reps per-op costs: on a tight loop, host noise is
+        # strictly additive, so the minimum is the estimator of the
+        # true cost and is stable across processes on a loaded host.
+        n = 5000
+        def _min_ns(body):
+            reps = []
+            for _ in range(4):
+                t0 = time.perf_counter_ns()
+                body(n)
+                reps.append((time.perf_counter_ns() - t0) / n)
+            return min(reps)
+
+        def _hook(m):
+            for _ in range(m):
+                ta = time.monotonic_ns()
+                tb = time.monotonic_ns()
+                led.record_pack(64, 256, ta, tb)
+
+        def _scope(m):
+            for _ in range(m):
+                with ledger.client_scope("bench:acct"):
+                    pass
+
+        def _hint(m):
+            for _ in range(m):
+                with ledger.step_hint(3):
+                    pass
+
+        hook_ns = _min_ns(_hook)
+        scope_ns = _min_ns(_scope)
+        hint_ns = _min_ns(_hint)
+        led.clear()
+
+        accounted_us = (wire_per_step * hook_ns + scopes_per_step * scope_ns
+                        + calls_per_step * hint_ns) / 1e3
+        # Denominator: the floor across all OFF windows (each already
+        # min-of-steps) — the same additive-noise argument as the
+        # per-op loops, keeping the ratio stable run to run.
+        off_floor_ms = min(off_mins) if off_mins else 0.0
+        accounted_pct = accounted_us / (off_floor_ms * 1e3) * 100.0 \
+            if off_floor_ms else 0.0
+
+        off_spread = ((max(off_mins) - min(off_mins)) / off_ms
+                      if off_ms else 0.0)
     finally:
+        sess.close()
+        close_inproc_cluster(cluster)
         ledger.configure(enabled=False)
         flight.configure(enabled=True)   # flight defaults ON
-    pct = max((on_ms - off_ms) / off_ms * 100.0, 0.0) if off_ms else 0.0
+
+    # The A/B median is trustworthy only when it clears the
+    # null-calibrated floor AND the pairs are internally coherent: a
+    # single pair of the wrong sign, or the OFF-window spread guard
+    # firing, is direct evidence that noise operates at the same scale
+    # as the claimed effect — fall back to per-op accounting.
+    if ab_median <= noise_floor:
+        ab_unreadable = "below host noise floor"
+    elif off_spread > SPREAD_VERDICT_LIMIT:
+        ab_unreadable = (f"window spread {off_spread:.1%} "
+                         f"> {SPREAD_VERDICT_LIMIT:.0%}, loaded host")
+    elif min(ab_pcts) <= 0.0:
+        ab_unreadable = "pairs straddle zero"
+    else:
+        ab_unreadable = None
+    pct = max(accounted_pct if ab_unreadable else ab_median, 0.0)
+    methodology = ("ab_paired_windows" if ab_unreadable is None
+                   else f"per_op_accounting (A/B {ab_unreadable})")
     return {
         "metric": "ledger_overhead_pct",
         "value": round(pct, 2),
-        "unit": "% of fleet step (min-of-steps, ledger+flight on vs off)",
+        "unit": "% of fleet step (ledger+flight enabled vs off)",
+        "methodology": methodology,
         "fleet_step_off_ms": round(off_ms, 3),
-        "fleet_step_on_ms": round(on_ms, 3),
+        "ab_median_pct": round(ab_median, 2),
+        "ab_pair_pcts": [round(v, 2) for v in ab_pcts],
+        "noise_floor_pct": round(noise_floor, 2),
+        "accounted_pct": round(accounted_pct, 3),
+        "accounted_us_per_step": round(accounted_us, 1),
+        "wire_records_per_step": round(wire_per_step, 1),
+        "scope_records_per_step": round(scopes_per_step, 1),
+        "per_record_hook_ns": round(hook_ns, 1),
+        "per_scope_ns": round(scope_ns, 1),
+        "per_hint_ns": round(hint_ns, 1),
         "disabled_noop": noop,
         "gate_below_2pct": bool(pct <= 2.0),
+        **_verdict_fields("ledger_overhead_pct", pct, off_spread),
     }
 
 

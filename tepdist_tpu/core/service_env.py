@@ -140,13 +140,21 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
      "the serde/orchestration/idle/compute gap table by "
      "tools/ledger_report.py; off by default (hot-path hooks cost one "
      "branch when off)"),
+    ("TEPDIST_LEDGER_RING", int, 16384, "ledger ring capacity per writer "
+     "thread in records (fixed-stride int64 slots preallocated at first "
+     "record; oldest records dropped and counted per category)"),
     ("TEPDIST_FLIGHT", bool, True, "serving flight recorder "
      "(telemetry/flight.py): bounded ring of per-request waterfall "
      "events (submit/admit/prefill/decode/restart/deliver) rendered by "
-     "tools/request_trace.py; on by default — one dict append per event"),
+     "tools/request_trace.py; on by default — one ring-slot write per "
+     "event, no allocation"),
     ("TEPDIST_FLIGHT_CAPACITY", int, 8192, "flight-recorder ring "
-     "capacity per process (oldest events dropped; overflow exported as "
-     "dropped)"),
+     "capacity per writer thread (oldest events dropped; overflow "
+     "exported as dropped)"),
+    ("TEPDIST_FLIGHT_SAMPLE", int, 1, "flight head-sampling stride: keep "
+     "every Nth request's waterfall (hash of request id), shed the rest "
+     "at record time and count them as sampled_out. 1 = record all; "
+     "the wildcard rid '*' bypasses sampling (engine-wide events)"),
     # --- static analysis --------------------------------------------------
     ("TEPDIST_VERIFY_PLAN", bool,
      "pytest" in sys.modules or "PYTEST_CURRENT_TEST" in os.environ,

@@ -177,11 +177,11 @@ def pack(header: Dict[str, Any], blobs: List[bytes] = ()) -> bytes:
     # reconcile (at toy frame sizes a few us/op of mutual overhead would
     # otherwise dominate the comparison).
     with span("serde:pack", cat="serde") as sp:
-        t0 = time.time_ns() // 1000 if led is not None else 0
+        t0 = time.monotonic_ns() if led is not None else 0
         segments, hb, bb = _build_segments(header, blobs)
         frame = b"".join(segments)
         sp.set(bytes=len(frame))
-        t1 = time.time_ns() // 1000 if led is not None else 0
+        t1 = time.monotonic_ns() if led is not None else 0
     if led is not None:
         led.record_pack(hb, bb, t0, t1)
     return frame
@@ -194,11 +194,11 @@ def pack_frames(header: Dict[str, Any], blobs: List[bytes] = ()) -> Frames:
     materialized, never how many are accounted."""
     led = wire_ledger.active()
     with span("serde:pack", cat="serde") as sp:
-        t0 = time.time_ns() // 1000 if led is not None else 0
+        t0 = time.monotonic_ns() if led is not None else 0
         segments, hb, bb = _build_segments(header, blobs)
         frames = Frames(segments, hb, bb)
         sp.set(bytes=frames.nbytes)
-        t1 = time.time_ns() // 1000 if led is not None else 0
+        t1 = time.monotonic_ns() if led is not None else 0
     if led is not None:
         led.record_pack(hb, bb, t0, t1)
     return frames
@@ -210,7 +210,7 @@ def _unpack_frames(frames: Frames):
     parse to the byte."""
     led = wire_ledger.active()
     with span("serde:unpack", cat="serde") as sp:
-        t0 = time.time_ns() // 1000 if led is not None else 0
+        t0 = time.monotonic_ns() if led is not None else 0
         head = frames.segments[0]
         if len(head) < 12 or bytes(head[0:4]) != _MAGIC:
             raise ValueError("bad envelope magic")
@@ -218,7 +218,7 @@ def _unpack_frames(frames: Frames):
         header = json.loads(bytes(head[8:8 + hlen]).decode())
         blobs = frames.segments[2::2]
         sp.set(bytes=frames.nbytes)
-        t1 = time.time_ns() // 1000 if led is not None else 0
+        t1 = time.monotonic_ns() if led is not None else 0
     if led is not None:
         led.record_unpack(frames.header_bytes, frames.blob_bytes, t0, t1)
     return header, blobs
@@ -253,7 +253,7 @@ def unpack(data) -> Tuple[Dict[str, Any], List[bytes]]:
     if total < 12 or bytes(mv[:4]) != _MAGIC:
         raise ValueError("bad envelope magic")
     with span("serde:unpack", cat="serde") as sp:
-        t0 = time.time_ns() // 1000 if led is not None else 0
+        t0 = time.monotonic_ns() if led is not None else 0
         off = 4
         (hlen,) = struct.unpack_from("<I", mv, off)
         off += 4
@@ -274,7 +274,7 @@ def unpack(data) -> Tuple[Dict[str, Any], List[bytes]]:
             blobs.append(mv[off:off + blen])
             off += blen
         sp.set(bytes=total)
-        t1 = time.time_ns() // 1000 if led is not None else 0
+        t1 = time.monotonic_ns() if led is not None else 0
     if led is not None:
         blob_total = sum(b.nbytes for b in blobs)
         led.record_unpack(total - blob_total, blob_total, t0, t1)
@@ -317,7 +317,7 @@ def encode_literal(x, wire_dtype: str = None) -> Tuple[Dict[str, Any], bytes]:
     """
     led = wire_ledger.active()
     with span("serde:encode", cat="serde") as sp:
-        t0 = time.time_ns() // 1000 if led is not None else 0
+        t0 = time.monotonic_ns() if led is not None else 0
         arr = np.asarray(x)
         meta = {"dtype": arr.dtype.name, "shape": list(arr.shape)}
         copies = 0
@@ -335,9 +335,9 @@ def encode_literal(x, wire_dtype: str = None) -> Tuple[Dict[str, Any], bytes]:
             blob = scales.tobytes() + q.tobytes()
             copies = 1
             sp.set(bytes=len(blob))
-            t1 = time.time_ns() // 1000 if led is not None else 0
+            t1 = time.monotonic_ns() if led is not None else 0
             if led is not None:
-                led.record_encode(t0, t1, copies=copies)
+                led.record_encode(t0, t1, copies)
             return (meta, blob)
         if wire_dtype and wire_dtype != "int8" and is_float:
             wdt = _resolve_dtype(wire_dtype)
@@ -351,16 +351,16 @@ def encode_literal(x, wire_dtype: str = None) -> Tuple[Dict[str, Any], bytes]:
             copies = 1
         blob = _blob_view(arr)
         sp.set(bytes=blob.nbytes)
-        t1 = time.time_ns() // 1000 if led is not None else 0
+        t1 = time.monotonic_ns() if led is not None else 0
     if led is not None:
-        led.record_encode(t0, t1, copies=copies)
+        led.record_encode(t0, t1, copies)
     return (meta, blob)
 
 
 def decode_literal(meta: Dict[str, Any], blob: bytes) -> np.ndarray:
     led = wire_ledger.active()
     with span("serde:decode", cat="serde") as sp:
-        t0 = time.time_ns() // 1000 if led is not None else 0
+        t0 = time.monotonic_ns() if led is not None else 0
         sp.set(bytes=_nbytes(blob))
         qscales = meta.get("qscales")
         if qscales is not None:
@@ -379,7 +379,7 @@ def decode_literal(meta: Dict[str, Any], blob: bytes) -> np.ndarray:
             wire_from = meta.get("wire_from")
             if wire_from:
                 out = out.astype(_resolve_dtype(wire_from))
-        t1 = time.time_ns() // 1000 if led is not None else 0
+        t1 = time.monotonic_ns() if led is not None else 0
     if led is not None:
         led.record_decode(t0, t1)
     return out

@@ -1303,6 +1303,12 @@ class TepdistServicer:
         dropped = t.dropped
         clear = bool(header.get("clear"))
         spans = t.snapshot(clear=clear)
+        ledger_snap = wire_ledger.ledger().snapshot(clear=clear)
+        flight_snap = flight.recorder().snapshot(clear=clear)
+        # Ring-loss counters mirrored top-level like spans_dropped so a
+        # caller can spot lossy telemetry without digging into the
+        # instrument payloads (tools/trace_summary.py renders these as
+        # LOSSY warnings).
         return protocol.pack({
             "ok": True,
             "task_index": self.task_index,
@@ -1310,9 +1316,12 @@ class TepdistServicer:
             "enabled": telemetry.enabled(),
             "spans": spans,
             "spans_dropped": dropped,
+            "ledger_dropped": ledger_snap.get("records_dropped", 0),
+            "flight_dropped": flight_snap.get("dropped", 0),
+            "flight_sampled_out": flight_snap.get("sampled_out", 0),
             "metrics": telemetry.metrics().snapshot(),
-            "ledger": wire_ledger.ledger().snapshot(clear=clear),
-            "flight": flight.recorder().snapshot(clear=clear),
+            "ledger": ledger_snap,
+            "flight": flight_snap,
         })
 
     # -- serving verbs (tepdist_tpu/serving/) ---------------------------

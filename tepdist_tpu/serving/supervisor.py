@@ -312,6 +312,8 @@ class ServingSupervisor:
                 return
             self.restarts += 1
             metrics().counter("engine_restarts").inc()
+            # rid "*" = engine-wide event: bypasses TEPDIST_FLIGHT_SAMPLE
+            # so a restart is never shed from a sampled waterfall.
             flight.record("*", "restart", gen=self.restarts,
                           reason=repr(exc))
             log.warning("serving engine fault (%r): restart %d/%d",

@@ -73,8 +73,12 @@ def build_trace(payloads: Iterable[Dict[str, Any]],
     ledgers: List[Dict[str, Any]] = []
     flights: List[List[Dict[str, Any]]] = []
     dropped: Dict[str, int] = {}
+    ledger_dropped: Dict[str, int] = {}
+    flight_dropped: Dict[str, int] = {}
+    flight_sampled_out: Dict[str, int] = {}
     for p in payloads:
         off = p.get("offset_us", 0.0)
+        proc = p.get("label") or str(p["pid"])
         events.extend(to_chrome_events(
             p.get("spans", ()), pid=p["pid"], offset_us=off,
             label=p.get("label")))
@@ -84,13 +88,18 @@ def build_trace(payloads: Iterable[Dict[str, Any]],
             # Shift onto the merge clock so the fleet ledger's step
             # windows and intervals line up with the span timeline.
             ledgers.append(_ledger.shift(p["ledger"], off))
-        if p.get("flight", {}).get("events"):
-            flights.append(_flight.shift(
-                p["flight"]["events"], off,
-                proc=p.get("label") or str(p["pid"])))
+            lost = int(p["ledger"].get("records_dropped", 0))
+            if lost:
+                ledger_dropped[proc] = lost
+        fl = p.get("flight") or {}
+        if fl.get("events"):
+            flights.append(_flight.shift(fl["events"], off, proc=proc))
+        if fl.get("dropped"):
+            flight_dropped[proc] = int(fl["dropped"])
+        if fl.get("sampled_out"):
+            flight_sampled_out[proc] = int(fl["sampled_out"])
         if p.get("spans_dropped"):
-            dropped[p.get("label") or str(p["pid"])] = int(
-                p["spans_dropped"])
+            dropped[proc] = int(p["spans_dropped"])
     trace: Dict[str, Any] = {"traceEvents": events, "displayTimeUnit": "ms"}
     meta: Dict[str, Any] = {}
     if snaps:
@@ -99,8 +108,16 @@ def build_trace(payloads: Iterable[Dict[str, Any]],
         meta["ledger"] = _ledger.merge(ledgers)
     if flights:
         meta["flight"] = _flight.merge(flights)
+    # Per-process ring-loss counters: a trace file must say it is lossy
+    # (dropped records read as idle time / missing waterfall hops).
     if dropped:
         meta["spans_dropped"] = dropped
+    if ledger_dropped:
+        meta["ledger_dropped"] = ledger_dropped
+    if flight_dropped:
+        meta["flight_dropped"] = flight_dropped
+    if flight_sampled_out:
+        meta["flight_sampled_out"] = flight_sampled_out
     if extra_metadata:
         meta.update(extra_metadata)
     if meta:
@@ -183,6 +200,26 @@ def dump_merged_trace(clients, path: Optional[str] = None,
             "missing spans read as idle time — raise "
             "TEPDIST_TRACE_CAPACITY or dump more often",
             ", ".join(f"{k}={v}" for k, v in sorted(lossy.items())))
+    ledger_lossy = {p.get("label") or str(p["pid"]):
+                    int((p.get("ledger") or {}).get("records_dropped", 0))
+                    for p in payloads
+                    if (p.get("ledger") or {}).get("records_dropped")}
+    if ledger_lossy:
+        log.warning(
+            "merged trace is LOSSY: ledger ring overflowed (%s records "
+            "dropped); gap-table sums undercount — raise "
+            "TEPDIST_LEDGER_RING or snapshot more often",
+            ", ".join(f"{k}={v}" for k, v in sorted(ledger_lossy.items())))
+    flight_lossy = {p.get("label") or str(p["pid"]):
+                    int((p.get("flight") or {}).get("dropped", 0))
+                    for p in payloads
+                    if (p.get("flight") or {}).get("dropped")}
+    if flight_lossy:
+        log.warning(
+            "merged trace is LOSSY: flight ring overflowed (%s events "
+            "dropped); request waterfalls have missing hops — raise "
+            "TEPDIST_FLIGHT_CAPACITY or lower TEPDIST_FLIGHT_SAMPLE",
+            ", ".join(f"{k}={v}" for k, v in sorted(flight_lossy.items())))
     return write_trace(build_trace(payloads, extra_metadata=extra_metadata),
                        path=path, name=name)
 

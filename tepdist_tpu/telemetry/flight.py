@@ -21,58 +21,193 @@ to spans and are merged clock-aligned by telemetry/export.py;
 ``tools/request_trace.py`` renders the text waterfall and the Perfetto
 flow-arrow export.
 
-Gating: ``TEPDIST_FLIGHT`` (default ON — the ring is cheap: one dict
-append per event, no serde) with ``TEPDIST_FLIGHT_CAPACITY`` bounding
-memory. Same singleton/disabled-path contract as trace.py.
+RECORD PATH (ISSUE 16 rebuild): each writer thread owns a preallocated
+stride-4 list ring (rid, ev, monotonic-ns timestamp, args-or-None) — no
+lock, no per-event dict; snapshot() merges the rings time-sorted and
+converts to epoch microseconds through a per-recorder anchor captured at
+construction (so repeated snapshots agree exactly). Per-token decode
+events from concurrent engine threads interleave by their ns clocks, so
+merged waterfalls keep causal order even when two hops land in the same
+microsecond.
+
+GRACEFUL DEGRADATION: under overload the recorder sheds *detail*, never
+correctness. ``TEPDIST_FLIGHT_SAMPLE`` = N keeps every event for roughly
+1/N of request ids — the split is a stable crc32 hash of the rid, so a
+sampled-in request keeps its COMPLETE waterfall on every process (crc32
+is deterministic cross-process, unlike ``hash()``), and supervisor-scope
+events (rid ``"*"``: restart, shed totals) always record. Everything
+sampled away is counted in the explicit ``sampled_out`` counter next to
+ring-overflow ``dropped``, and both ride through GetTelemetry into the
+merged-trace LOSSY warnings.
+
+Gating: ``TEPDIST_FLIGHT`` (default ON — enabled cost is gated by
+tools/obs_overhead.py ``flight_overhead_pct`` <= 2% on a serving burst)
+with ``TEPDIST_FLIGHT_CAPACITY`` bounding per-thread ring memory. Same
+singleton/disabled-path contract as trace.py.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from collections import deque
+import weakref
+import zlib
 from typing import Any, Dict, Iterable, List, Optional
 
+_STRIDE = 4
 
-def _now_us() -> int:
-    return time.time_ns() // 1000
+
+class _Ring:
+    """One writer thread's event ring: ``cap + 1`` physical slots so a
+    quiescent snapshot exports the full logical capacity while a racing
+    one can discard the single slot a concurrent writer may be filling
+    (see FlightRecorder.snapshot)."""
+
+    __slots__ = ("data", "cap", "phys", "cursor", "base", "sampled_out",
+                 "sampled_base")
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self.phys = cap + 1
+        self.data: List[Any] = [None] * (_STRIDE * self.phys)
+        self.cursor = 0
+        self.base = 0
+        self.sampled_out = 0
+        self.sampled_base = 0
+
+
+class _RingHandle:
+    """Parks the thread's ring for adoption when the thread dies (see
+    ledger._RingHandle — same lifecycle)."""
+
+    __slots__ = ("ring", "_rec")
+
+    def __init__(self, rec: "FlightRecorder", ring: _Ring):
+        self.ring = ring
+        self._rec = weakref.ref(rec)
+
+    def __del__(self):
+        rec = self._rec()
+        if rec is not None:
+            rec._park(self.ring)
 
 
 class FlightRecorder:
-    """Bounded, thread-safe ring of per-request waterfall events."""
+    """Bounded per-request event recorder: lock-free per-thread rings."""
 
-    def __init__(self, enabled: bool = True, capacity: int = 8192):
+    def __init__(self, enabled: bool = True, capacity: int = 8192,
+                 sample: int = 1):
         self.enabled = enabled
         self.capacity = max(int(capacity), 16)
-        self._lock = threading.Lock()
-        self._events: deque = deque(maxlen=self.capacity)
-        self.dropped = 0
+        self.sample = max(int(sample), 1)
+        self._reg_lock = threading.Lock()
+        self._rings: List[_Ring] = []
+        self._free: List[_Ring] = []
+        self._tlr = threading.local()
+        m0 = time.monotonic_ns()
+        t = time.time_ns()
+        m1 = time.monotonic_ns()
+        self._anchor_ns = t - (m0 + m1) // 2
+
+    def _new_ring(self) -> _Ring:
+        with self._reg_lock:
+            if self._free:
+                r = self._free.pop()
+            else:
+                r = _Ring(self.capacity)
+                self._rings.append(r)
+        tlr = self._tlr
+        tlr.handle = _RingHandle(self, r)
+        tlr.ring = r
+        return r
+
+    def _park(self, ring: _Ring) -> None:
+        with self._reg_lock:
+            self._free.append(ring)
 
     def record(self, rid: str, ev: str, **args: Any) -> None:
         if not self.enabled:
             return
-        entry = {"rid": rid, "ev": ev, "ts": _now_us()}
-        if args:
-            entry["args"] = args
-        with self._lock:
-            if len(self._events) >= self.capacity:
-                self.dropped += 1
-            self._events.append(entry)
+        n = self.sample
+        if n > 1 and rid != "*" and zlib.crc32(rid.encode()) % n:
+            try:
+                r = self._tlr.ring
+            except AttributeError:
+                r = self._new_ring()
+            r.sampled_out += 1
+            return
+        try:
+            r = self._tlr.ring
+        except AttributeError:
+            r = self._new_ring()
+        c = r.cursor
+        i = (c % r.phys) * _STRIDE
+        d = r.data
+        d[i] = rid
+        d[i + 1] = ev
+        d[i + 2] = time.monotonic_ns()
+        d[i + 3] = args or None
+        r.cursor = c + 1          # publish AFTER the slot writes
 
     def snapshot(self, clear: bool = False) -> Dict[str, Any]:
-        with self._lock:
-            out = {"enabled": self.enabled,
-                   "events": [dict(e) for e in self._events],
-                   "dropped": self.dropped}
-            if clear:
-                self._events.clear()
-                self.dropped = 0
+        with self._reg_lock:
+            rings = list(self._rings)
+        anchor = self._anchor_ns
+        raw: List[Any] = []
+        dropped = 0
+        sampled_out = 0
+        for ridx, r in enumerate(rings):
+            cur = r.cursor
+            data = r.data[:]      # one C-level copy under the GIL
+            cur2 = r.cursor
+            # Record w rewrites slot (w - phys): with writers at most at
+            # cur2 by copy end, anything <= cur2 - phys may be torn.
+            # Quiescent (cur2 == cur) this reduces to the full capacity.
+            lo = max(r.base, cur - r.cap, cur2 - r.phys + 1)
+            phys = r.phys
+            for c in range(lo, cur):
+                i = (c % phys) * _STRIDE
+                raw.append((data[i + 2], ridx, c, data[i], data[i + 1],
+                            data[i + 3]))
+            dropped += (cur - r.base) - (cur - lo)
+            sampled_out += r.sampled_out - r.sampled_base
+        raw.sort()                # ns clock, then (ring, seq) tie-break
+        events = []
+        for ts_ns, _ridx, _c, rid, ev, args in raw:
+            entry = {"rid": rid, "ev": ev, "ts": (ts_ns + anchor) // 1000}
+            if args:
+                entry["args"] = dict(args)
+            events.append(entry)
+        out = {"enabled": self.enabled, "events": events,
+               "dropped": dropped, "sampled_out": sampled_out}
+        if clear:
+            self.clear()
         return out
 
+    @property
+    def dropped(self) -> int:
+        """Ring-overflow events lost since the last clear()."""
+        with self._reg_lock:
+            rings = list(self._rings)
+        lost = 0
+        for r in rings:
+            cur = r.cursor
+            lost += max((cur - r.base) - r.cap, 0)
+        return lost
+
+    @property
+    def sampled_out(self) -> int:
+        """Events shed by TEPDIST_FLIGHT_SAMPLE since the last clear()."""
+        with self._reg_lock:
+            rings = list(self._rings)
+        return sum(r.sampled_out - r.sampled_base for r in rings)
+
     def clear(self) -> None:
-        with self._lock:
-            self._events.clear()
-            self.dropped = 0
+        with self._reg_lock:
+            rings = list(self._rings)
+        for r in rings:
+            r.base = r.cursor
+            r.sampled_base = r.sampled_out
 
 
 # -- module singleton -------------------------------------------------------
@@ -89,7 +224,8 @@ def _init_from_env() -> FlightRecorder:
             env = ServiceEnv.get()
             _RECORDER = FlightRecorder(
                 enabled=bool(env.tepdist_flight),
-                capacity=int(env.tepdist_flight_capacity))
+                capacity=int(env.tepdist_flight_capacity),
+                sample=int(getattr(env, "tepdist_flight_sample", 1) or 1))
     return _RECORDER
 
 
@@ -101,16 +237,22 @@ def recorder() -> FlightRecorder:
 
 
 def configure(enabled: Optional[bool] = None,
-              capacity: Optional[int] = None) -> FlightRecorder:
+              capacity: Optional[int] = None,
+              sample: Optional[int] = None) -> FlightRecorder:
     global _RECORDER
     rec = recorder()
     if capacity is not None and capacity != rec.capacity:
         rec = FlightRecorder(enabled=rec.enabled if enabled is None
-                             else enabled, capacity=capacity)
+                             else enabled, capacity=capacity,
+                             sample=rec.sample if sample is None
+                             else sample)
         with _INIT_LOCK:
             _RECORDER = rec
-    elif enabled is not None:
-        rec.enabled = enabled
+    else:
+        if enabled is not None:
+            rec.enabled = enabled
+        if sample is not None:
+            rec.sample = max(int(sample), 1)
     return rec
 
 

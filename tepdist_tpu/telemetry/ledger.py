@@ -17,6 +17,24 @@ at the four transport chokepoints:
 * ``rpc/retry.py`` — retry counts and backoff (client queue wait).
 * ``rpc/server.py`` / inproc dispatch — server handler wall time.
 
+RECORD PATH (ISSUE 16 rebuild — the PR 11 treatment applied to the
+instruments themselves): each writer thread owns a preallocated
+fixed-stride ``array('q')`` ring. A record is seven int64 slot writes +
+one cursor bump — no lock, no dict, no per-record allocation; verbs are
+interned to integer codes and timestamps are raw ``time.monotonic_ns()``
+(immune to NTP steps; converted to epoch microseconds at read time
+through a per-ledger anchor captured at construction). ALL aggregation —
+per-verb rollups, per-step tables, window widening, interval lists — is
+deferred to ``snapshot()`` read time, which replays the rings and
+reconstructs exactly the dict shapes the previous implementation
+exported, so ``gap_table``/``reconcile``/``shift``/``merge`` and every
+downstream consumer (export.py, trace_summary, ledger_report) are
+untouched. Torn reads are impossible by construction: the ring holds one
+spare slot beyond its logical capacity and the reader discards anything
+a concurrent writer could have been overwriting during the (GIL-atomic)
+buffer copy; racing records are shed oldest-first and counted as
+dropped, never mis-read.
+
 Attribution uses a THREAD-LOCAL context (verb, side, step): the in-proc
 transport runs the servicer handler on the caller's own thread, so a
 context set around the client call is visible to the server-side
@@ -41,15 +59,26 @@ step wall against PR 6's fidelity attribution.
 
 Gating: ``TEPDIST_LEDGER`` (default off). Disabled cost is one module
 attribute load + one branch per hook (same contract as trace.py's
-``_NULL_SPAN``).
+``_NULL_SPAN``). Enabled cost is gated by tools/obs_overhead.py
+(``ledger_overhead_pct`` <= 2% of the fleet step, a perf_gate
+DEFAULT_KEYS watchlist entry). Ring capacity: ``TEPDIST_LEDGER_RING``
+records per writer thread; overflow drops oldest records and is exported
+per category in ``intervals_dropped`` (plus a ``records_dropped``
+total).
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from collections import OrderedDict, deque
+import weakref
+from array import array
 from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+try:  # native write path (telemetry/_fastobs.c); pure Python otherwise
+    from tepdist_tpu.telemetry import _fastobs
+except Exception:  # pragma: no cover — loader import never raises in-tree
+    _fastobs = None  # type: ignore[assignment]
 
 _UNATTRIBUTED = "_unattributed"
 
@@ -65,9 +94,27 @@ _STAT_KEYS = ("calls", "retries", "backoff_us",
               # down-cast. merge() tolerates old snapshots without it.
               "copies")
 
+# Record kinds (slot 0 of each ring record).
+_K_PACK, _K_UNPACK, _K_ENCODE, _K_DECODE, _K_CALL, _K_HANDLER, \
+    _K_RETRY, _K_WINDOW = range(8)
+_N_KINDS = 8
+# Which gap-table category each interval-bearing kind feeds.
+_KIND_CAT = {_K_PACK: "serde", _K_UNPACK: "serde", _K_ENCODE: "serde",
+             _K_DECODE: "serde", _K_CALL: "rpc", _K_HANDLER: "handler"}
+
+# Ring record layout: kind, verb code, step (-1 = none), t0_ns, t1_ns,
+# a, b — a/b are kind-specific payloads (byte counts, copies, backoff).
+_STRIDE = 7
+
 
 def _new_stats() -> Dict[str, float]:
     return {k: 0 for k in _STAT_KEYS}
+
+
+def now_ns() -> int:
+    """The ledger's record clock: raw monotonic ns. Chokepoints bracket
+    work with this (NOT epoch time); snapshot() converts to epoch us."""
+    return time.monotonic_ns()
 
 
 class _Tls(threading.local):
@@ -77,6 +124,45 @@ class _Tls(threading.local):
 
 
 _TLS = _Tls()
+
+
+class _Ring:
+    """One writer thread's record ring. ``phys`` (= capacity + 1) slots:
+    the spare slot is what lets a quiescent reader export the FULL
+    logical capacity while a racing reader can still prove which slots a
+    concurrent writer might have been rewriting (see snapshot())."""
+
+    __slots__ = ("data", "cap", "phys", "cursor", "base",
+                 "kind_writes", "kind_base")
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self.phys = cap + 1
+        self.data = array("q", bytes(8 * _STRIDE * self.phys))
+        self.cursor = 0      # records ever written (published AFTER slots)
+        self.base = 0        # first record index since the last clear()
+        self.kind_writes = [0] * _N_KINDS
+        self.kind_base = [0] * _N_KINDS
+
+
+class _RingHandle:
+    """Thread-local ring holder. When the owning thread dies, CPython
+    drops its thread-local dict and this handle's finalizer parks the
+    ring for adoption by the next new thread — short-lived worker
+    threads (the executor spawns a few per step) must not each pay the
+    ~200us preallocation, and dead threads' unread records must stay
+    visible to snapshot() until a clear()."""
+
+    __slots__ = ("ring", "_led")
+
+    def __init__(self, led: "RpcLedger", ring: _Ring):
+        self.ring = ring
+        self._led = weakref.ref(led)
+
+    def __del__(self):
+        led = self._led()
+        if led is not None:
+            led._park(self.ring)
 
 
 class _NullCtx:
@@ -94,54 +180,60 @@ class _NullCtx:
 _NULL_CTX = _NullCtx()
 
 
-def _now_us() -> int:
-    return time.time_ns() // 1000
-
-
 class _VerbScope:
     """Client- or server-side scope for one verb: sets the thread-local
     context on entry, records the wall interval + per-verb time on exit.
     The previous context is restored, so the in-proc server scope nested
     inside the client scope inherits (and then returns) verb/step."""
 
-    __slots__ = ("_led", "_verb", "_side", "_step", "_t0",
+    __slots__ = ("_led", "_verb", "_kind", "_step", "_t0",
                  "_prev")
 
     def __init__(self, led: "RpcLedger", verb: str, side: str,
                  step: Optional[int]):
         self._led = led
         self._verb = verb
-        self._side = side
+        self._kind = _K_CALL if side == "client" else _K_HANDLER
         self._step = step
         self._t0 = 0
-        self._prev: Tuple[Optional[str], str, Optional[int]] = (None,
-                                                                "client",
-                                                                None)
+        self._prev: Any = (None, "client", None)
 
     def __enter__(self) -> "_VerbScope":
-        tls = _TLS
-        self._prev = (tls.verb, tls.side, tls.step)
-        tls.verb = self._verb
-        tls.side = self._side
-        # A nested scope keeps the outer step when it has none of its own
-        # (server handler under a stepped client call).
-        if self._step is not None:
-            tls.step = self._step
-        self._t0 = _now_us()
+        led = self._led
+        core = led._core
+        if core is not None:
+            code = led._verb_codes.get(self._verb)
+            if code is None:
+                code = led._intern(self._verb)
+            step = self._step
+            # A nested scope keeps the outer step when it has none of
+            # its own (server handler under a stepped client call):
+            # the -2 sentinel tells the core to leave the step alone.
+            self._prev = core.swap_ctx(code, -2 if step is None else step)
+        else:
+            tls = _TLS
+            self._prev = (tls.verb, tls.side, tls.step)
+            tls.verb = self._verb
+            tls.side = "client" if self._kind == _K_CALL else "server"
+            if self._step is not None:
+                tls.step = self._step
+        self._t0 = time.monotonic_ns()
         return self
 
     def __exit__(self, *exc) -> bool:
-        t1 = _now_us()
+        led = self._led
+        core = led._core
+        if core is not None:
+            # Record BEFORE restoring: the scope's own verb/step are the
+            # live context (t1 is taken inside the core).
+            core.rec_scope(self._kind, self._t0)
+            core.swap_ctx(*self._prev)
+            return False
+        t1 = time.monotonic_ns()
         tls = _TLS
         tls.verb, tls.side, tls.step = self._prev
-        if self._side == "client":
-            self._led._record_call(self._verb, tls.step if
-                                   self._step is None else self._step,
-                                   self._t0, t1)
-        else:
-            self._led._record_handler(self._verb, tls.step if
-                                      self._step is None else self._step,
-                                      self._t0, t1)
+        step = tls.step if self._step is None else self._step
+        led._rec(self._kind, self._verb, step, self._t0, t1, 0, 0)
         return False
 
 
@@ -158,14 +250,26 @@ class _StepScope:
         self._prev: Optional[int] = None
 
     def __enter__(self) -> "_StepScope":
-        self._prev = _TLS.step
-        _TLS.step = self._step
-        self._t0 = _now_us()
+        core = self._led._core
+        if core is not None:
+            self._prev = core.set_step(self._step)
+        else:
+            self._prev = _TLS.step
+            _TLS.step = self._step
+        self._t0 = time.monotonic_ns()
         return self
 
     def __exit__(self, *exc) -> bool:
+        led = self._led
+        core = led._core
+        if core is not None:
+            core.rec(_K_WINDOW, 0, self._step, self._t0,
+                     time.monotonic_ns(), 0, 0)
+            core.set_step(self._prev)
+            return False
         _TLS.step = self._prev
-        self._led._record_window(self._step, self._t0, _now_us())
+        led._rec(_K_WINDOW, None, self._step, self._t0,
+                 time.monotonic_ns(), 0, 0)
         return False
 
 
@@ -174,162 +278,345 @@ class _StepHint:
     Used where the step is known from a header but the window belongs to
     someone else (client call dispatch, server ExecuteRemotePlan)."""
 
-    __slots__ = ("_step", "_prev")
+    __slots__ = ("_led", "_step", "_prev")
 
-    def __init__(self, step: Optional[int]):
+    def __init__(self, led: "RpcLedger", step: Optional[int]):
+        self._led = led
         self._step = step
         self._prev: Optional[int] = None
 
     def __enter__(self) -> "_StepHint":
-        self._prev = _TLS.step
-        if self._step is not None:
-            _TLS.step = int(self._step)
+        core = self._led._core
+        if core is not None:
+            if self._step is not None:
+                self._prev = core.set_step(int(self._step))
+        else:
+            self._prev = _TLS.step
+            if self._step is not None:
+                _TLS.step = int(self._step)
         return self
 
     def __exit__(self, *exc) -> bool:
-        _TLS.step = self._prev
+        core = self._led._core
+        if core is not None:
+            if self._step is not None:
+                core.set_step(self._prev)
+        else:
+            _TLS.step = self._prev
         return False
 
 
 class RpcLedger:
-    """Bounded, thread-safe aggregate of wire/serde activity."""
+    """Bounded wire/serde recorder: lock-free per-thread rings on the
+    write side, full aggregation on the read side."""
 
-    MAX_INTERVALS = 16384     # per category ring (oldest dropped+counted)
-    MAX_STEPS = 256           # per-step rollups kept
+    RING_RECORDS = 16384      # per writer thread (oldest dropped+counted)
+    MAX_STEPS = 256           # per-step rollups kept in snapshot()
     EXPORT_INTERVALS = 8192   # per category cap in snapshot()
 
-    def __init__(self, enabled: bool = False):
+    def __init__(self, enabled: bool = False,
+                 ring_records: Optional[int] = None):
         self.enabled = enabled
-        self._lock = threading.Lock()
-        self._verbs: Dict[str, Dict[str, float]] = {}
-        self._steps: "OrderedDict[int, Dict[str, Dict[str, float]]]" = \
-            OrderedDict()
-        self._windows: "OrderedDict[int, List[int]]" = OrderedDict()
-        self._ivs: Dict[str, deque] = {c: deque(maxlen=self.MAX_INTERVALS)
-                                       for c in _CATS}
-        self.dropped: Dict[str, int] = {c: 0 for c in _CATS}
+        self._ring_records = max(int(ring_records or self.RING_RECORDS), 4)
+        self._reg_lock = threading.Lock()
+        self._rings: List[_Ring] = []
+        self._free: List[_Ring] = []   # parked rings of dead threads
+        self._tlr = threading.local()
+        # Verb interning: recording stores int codes; the name table is
+        # append-only so a read needs no lock. None (no context) is
+        # pre-interned as code 0 -> "_unattributed".
+        self._verb_codes: Dict[Optional[str], int] = {None: 0,
+                                                      _UNATTRIBUTED: 0}
+        self._verb_names: List[str] = [_UNATTRIBUTED]
+        # Epoch anchor, captured ONCE: snapshot() maps monotonic record
+        # clocks onto epoch us with a constant offset, so repeated
+        # snapshots of the same records agree to the microsecond. The
+        # monotonic sandwich halves the clock-call-gap error.
+        m0 = time.monotonic_ns()
+        t = time.time_ns()
+        m1 = time.monotonic_ns()
+        self._anchor_ns = t - (m0 + m1) // 2
+        # Native ring core when the C extension is buildable. The
+        # record_* hot paths are swapped per instance so the common case
+        # is one Python frame (TLS context + verb-code lookup) plus one
+        # C call; the pure-Python rings below stay as the verified-equal
+        # fallback and both drain through the same snapshot() code.
+        mod = _fastobs.load() if _fastobs is not None else None
+        self._core = mod.LedgerCore(self._ring_records) \
+            if mod is not None else None
+        if self._core is not None:
+            # The transport hooks call these attributes directly: bind
+            # the core's bound C methods so one enabled record is ONE
+            # C call — verb/step ride in the core's per-thread context,
+            # which the scopes below swap natively.
+            self._rec = self._rec_c
+            self.record_pack = self._core.rec_pack
+            self.record_unpack = self._core.rec_unpack
+            self.record_encode = self._core.rec_encode
+            self.record_decode = self._core.rec_decode
+            self.record_retry = self._record_retry_c
+
+    # -- write side (hot path) ------------------------------------------
+    def _new_ring(self) -> _Ring:
+        with self._reg_lock:
+            if self._free:
+                r = self._free.pop()   # adopt a dead thread's ring
+            else:
+                r = _Ring(self._ring_records)
+                self._rings.append(r)
+        tlr = self._tlr
+        tlr.handle = _RingHandle(self, r)
+        tlr.ring = r
+        return r
+
+    def _park(self, ring: _Ring) -> None:
+        with self._reg_lock:
+            self._free.append(ring)
+
+    def _intern(self, verb: Optional[str]) -> int:
+        with self._reg_lock:
+            code = self._verb_codes.get(verb)
+            if code is None:
+                code = len(self._verb_names)
+                self._verb_names.append(verb)
+                self._verb_codes[verb] = code
+        return code
+
+    def _rec(self, kind: int, verb: Optional[str], step: Optional[int],
+             t0: int, t1: int, a: int, b: int) -> None:
+        """Append one fixed-stride record to this thread's ring. The
+        cursor is published AFTER the slot writes, so a reader counting
+        ``cursor`` records can never see a half-written one."""
+        try:
+            r = self._tlr.ring
+        except AttributeError:
+            r = self._new_ring()
+        code = self._verb_codes.get(verb)
+        if code is None:
+            code = self._intern(verb)
+        c = r.cursor
+        i = (c % r.phys) * _STRIDE
+        d = r.data
+        d[i] = kind
+        d[i + 1] = code
+        d[i + 2] = -1 if step is None else step
+        d[i + 3] = t0
+        d[i + 4] = t1
+        d[i + 5] = a
+        d[i + 6] = b
+        r.kind_writes[kind] += 1
+        r.cursor = c + 1
 
     # -- low-level recording (called from the transport hooks) ----------
-    def _verb_stats(self, verb: Optional[str],
-                    step: Optional[int]) -> List[Dict[str, float]]:
-        """The global per-verb row plus (when a step is known) the
-        per-step rollup row — callers add to both. Lock held by caller."""
-        verb = verb or _UNATTRIBUTED
-        rows = [self._verbs.setdefault(verb, _new_stats())]
-        if step is not None:
-            by_verb = self._steps.get(step)
-            if by_verb is None:
-                by_verb = self._steps[step] = {}
-                while len(self._steps) > self.MAX_STEPS:
-                    self._steps.popitem(last=False)
-            rows.append(by_verb.setdefault(verb, _new_stats()))
-        return rows
-
-    def _add_iv(self, cat: str, t0_us: int, t1_us: int) -> None:
-        ivs = self._ivs[cat]
-        if len(ivs) >= self.MAX_INTERVALS:
-            self.dropped[cat] += 1
-        ivs.append((t0_us, t1_us - t0_us))
+    # Timestamps are time.monotonic_ns() (see now_ns()).
 
     def record_pack(self, header_bytes: int, blob_bytes: int,
-                    t0_us: int, t1_us: int) -> None:
+                    t0_ns: int, t1_ns: int) -> None:
         tls = _TLS
-        with self._lock:
-            for s in self._verb_stats(tls.verb, tls.step):
-                s["tx_header_bytes"] += header_bytes
-                s["tx_blob_bytes"] += blob_bytes
-                s["encode_us"] += t1_us - t0_us
-            self._add_iv("serde", t0_us, t1_us)
+        self._rec(_K_PACK, tls.verb, tls.step, t0_ns, t1_ns,
+                  header_bytes, blob_bytes)
 
     def record_unpack(self, header_bytes: int, blob_bytes: int,
-                      t0_us: int, t1_us: int) -> None:
+                      t0_ns: int, t1_ns: int) -> None:
         tls = _TLS
-        with self._lock:
-            for s in self._verb_stats(tls.verb, tls.step):
-                s["rx_header_bytes"] += header_bytes
-                s["rx_blob_bytes"] += blob_bytes
-                s["decode_us"] += t1_us - t0_us
-            self._add_iv("serde", t0_us, t1_us)
+        self._rec(_K_UNPACK, tls.verb, tls.step, t0_ns, t1_ns,
+                  header_bytes, blob_bytes)
 
-    def record_encode(self, t0_us: int, t1_us: int,
+    def record_encode(self, t0_ns: int, t1_ns: int,
                       copies: int = 0) -> None:
         tls = _TLS
-        with self._lock:
-            for s in self._verb_stats(tls.verb, tls.step):
-                s["encode_us"] += t1_us - t0_us
-                s["copies"] += copies
-            self._add_iv("serde", t0_us, t1_us)
+        self._rec(_K_ENCODE, tls.verb, tls.step, t0_ns, t1_ns, copies, 0)
 
-    def record_decode(self, t0_us: int, t1_us: int) -> None:
+    def record_decode(self, t0_ns: int, t1_ns: int) -> None:
         tls = _TLS
-        with self._lock:
-            for s in self._verb_stats(tls.verb, tls.step):
-                s["decode_us"] += t1_us - t0_us
-            self._add_iv("serde", t0_us, t1_us)
+        self._rec(_K_DECODE, tls.verb, tls.step, t0_ns, t1_ns, 0, 0)
 
     def record_retry(self, verb: str, backoff_s: float) -> None:
-        with self._lock:
-            for s in self._verb_stats(verb, _TLS.step):
-                s["retries"] += 1
-                s["backoff_us"] += backoff_s * 1e6
+        self._rec(_K_RETRY, verb, _TLS.step, 0, 0,
+                  int(backoff_s * 1e6), 0)
 
-    def _record_call(self, verb: str, step: Optional[int],
-                     t0_us: int, t1_us: int) -> None:
-        with self._lock:
-            for s in self._verb_stats(verb, step):
-                s["calls"] += 1
-                s["client_us"] += t1_us - t0_us
-            self._add_iv("rpc", t0_us, t1_us)
+    # -- native-core record paths (bound over the ones above when the C
+    # extension is available; same record layout, same drop accounting) -
+    def _rec_c(self, kind: int, verb: Optional[str], step: Optional[int],
+               t0: int, t1: int, a: int, b: int) -> None:
+        code = self._verb_codes.get(verb)
+        if code is None:
+            code = self._intern(verb)
+        self._core.rec(kind, code, -1 if step is None else step,
+                       t0, t1, a, b)
 
-    def _record_handler(self, verb: str, step: Optional[int],
-                        t0_us: int, t1_us: int) -> None:
-        with self._lock:
-            for s in self._verb_stats(verb, step):
-                s["server_us"] += t1_us - t0_us
-            self._add_iv("handler", t0_us, t1_us)
+    def _record_retry_c(self, verb: str, backoff_s: float) -> None:
+        code = self._verb_codes.get(verb)
+        if code is None:
+            code = self._intern(verb)
+        self._core.rec_retry(code, int(backoff_s * 1e6))
 
-    def _record_window(self, step: int, t0_us: int, t1_us: int) -> None:
-        with self._lock:
-            w = self._windows.get(step)
-            if w is None:
-                self._windows[step] = [t0_us, t1_us]
-                while len(self._windows) > self.MAX_STEPS:
-                    self._windows.popitem(last=False)
-            else:                     # re-executed step: widen the window
-                w[0] = min(w[0], t0_us)
-                w[1] = max(w[1], t1_us)
+    # -- read side ------------------------------------------------------
+    def _drain(self) -> Tuple[List[Tuple[int, ...]], Dict[str, int],
+                              int, List[str]]:
+        """Collect every readable record across all rings.
 
-    # -- export ---------------------------------------------------------
+        Per ring: read the cursor, slice-copy the buffer (GIL-atomic),
+        re-read the cursor. Records a writer might have been rewriting
+        during the copy — anything a post-copy writer position proves
+        could alias a surviving slot — are discarded and counted as
+        dropped, so a racing snapshot sheds oldest records rather than
+        exporting torn ones. When writers are quiescent the export is
+        exact: all ``min(cursor - base, cap)`` records, with drop counts
+        equal to ``writes - survivors`` per category."""
+        with self._reg_lock:
+            rings = list(self._rings)
+            names = list(self._verb_names)
+        recs: List[Tuple[int, ...]] = []
+        cat_dropped = {c: 0 for c in _CATS}
+        total_dropped = 0
+        if self._core is not None:
+            recs, kind_lost = self._core.drain()
+            for k, lost in enumerate(kind_lost):
+                if lost:
+                    total_dropped += lost
+                    cat = _KIND_CAT.get(k)
+                    if cat is not None:
+                        cat_dropped[cat] += lost
+        for r in rings:
+            cur = r.cursor
+            data = r.data[:]          # one C-level memcpy under the GIL
+            cur2 = r.cursor
+            # Writers reached at most record cur2 by copy end; record w
+            # overwrites slot (w - phys), so anything <= cur2 - phys may
+            # be torn. Quiescent (cur2 == cur): lo == cur - cap exactly.
+            lo = max(r.base, cur - r.cap, cur2 - r.phys + 1)
+            surv_by_kind = [0] * _N_KINDS
+            phys = r.phys
+            for c in range(lo, cur):
+                i = (c % phys) * _STRIDE
+                surv_by_kind[data[i]] += 1
+                recs.append(tuple(data[i:i + _STRIDE]))
+            writes = [r.kind_writes[k] - r.kind_base[k]
+                      for k in range(_N_KINDS)]
+            for k in range(_N_KINDS):
+                lost = max(writes[k] - surv_by_kind[k], 0)
+                if not lost:
+                    continue
+                total_dropped += lost
+                cat = _KIND_CAT.get(k)
+                if cat is not None:
+                    cat_dropped[cat] += lost
+        return recs, cat_dropped, total_dropped, names
+
     def snapshot(self, clear: bool = False) -> Dict[str, Any]:
-        with self._lock:
-            out = {
-                "enabled": self.enabled,
-                "verbs": {v: dict(s) for v, s in self._verbs.items()},
-                "steps": {str(k): {v: dict(s) for v, s in by.items()}
-                          for k, by in self._steps.items()},
-                "windows": {str(k): list(w)
-                            for k, w in self._windows.items()},
-                "intervals": {
-                    c: [list(iv) for iv in
-                        list(self._ivs[c])[-self.EXPORT_INTERVALS:]]
-                    for c in _CATS},
-                "intervals_dropped": dict(self.dropped),
-            }
-            if clear:
-                self._clear_locked()
+        recs, cat_dropped, total_dropped, names = self._drain()
+        anchor = self._anchor_ns
+        verbs: Dict[str, Dict[str, float]] = {}
+        steps: Dict[int, Dict[str, Dict[str, float]]] = {}
+        windows: Dict[int, List[int]] = {}
+        intervals: Dict[str, List[List[int]]] = {c: [] for c in _CATS}
+
+        def rows(code: int, step: int) -> List[Dict[str, float]]:
+            verb = names[code] if code < len(names) else _UNATTRIBUTED
+            row = verbs.get(verb)
+            if row is None:
+                row = verbs[verb] = _new_stats()
+            out = [row]
+            if step >= 0:
+                by = steps.get(step)
+                if by is None:
+                    by = steps[step] = {}
+                srow = by.get(verb)
+                if srow is None:
+                    srow = by[verb] = _new_stats()
+                out.append(srow)
+            return out
+
+        for kind, code, step, t0, t1, a, b in recs:
+            if kind == _K_WINDOW:
+                lo_us = (t0 + anchor) // 1000
+                hi_us = (t1 + anchor) // 1000
+                w = windows.get(step)
+                if w is None:
+                    windows[step] = [lo_us, hi_us]
+                else:                 # re-executed step: widen the window
+                    if lo_us < w[0]:
+                        w[0] = lo_us
+                    if hi_us > w[1]:
+                        w[1] = hi_us
+                continue
+            if kind == _K_RETRY:
+                for s in rows(code, step):
+                    s["retries"] += 1
+                    s["backoff_us"] += a
+                continue
+            us = (t1 - t0) // 1000
+            if kind == _K_PACK:
+                for s in rows(code, step):
+                    s["tx_header_bytes"] += a
+                    s["tx_blob_bytes"] += b
+                    s["encode_us"] += us
+            elif kind == _K_UNPACK:
+                for s in rows(code, step):
+                    s["rx_header_bytes"] += a
+                    s["rx_blob_bytes"] += b
+                    s["decode_us"] += us
+            elif kind == _K_ENCODE:
+                for s in rows(code, step):
+                    s["encode_us"] += us
+                    s["copies"] += a
+            elif kind == _K_DECODE:
+                for s in rows(code, step):
+                    s["decode_us"] += us
+            elif kind == _K_CALL:
+                for s in rows(code, step):
+                    s["calls"] += 1
+                    s["client_us"] += us
+            else:  # _K_HANDLER
+                for s in rows(code, step):
+                    s["server_us"] += us
+            intervals[_KIND_CAT[kind]].append(
+                [(t0 + anchor) // 1000, us])
+
+        # Bound the per-step rollups (the write path no longer evicts):
+        # keep the newest MAX_STEPS steps, matching the old OrderedDict
+        # popitem(last=False) policy.
+        if len(steps) > self.MAX_STEPS:
+            for k in sorted(steps)[:-self.MAX_STEPS]:
+                del steps[k]
+        if len(windows) > self.MAX_STEPS:
+            for k in sorted(windows)[:-self.MAX_STEPS]:
+                del windows[k]
+        for c in _CATS:
+            ivs = intervals[c]
+            ivs.sort(key=lambda iv: iv[0])
+            if len(ivs) > self.EXPORT_INTERVALS:
+                intervals[c] = ivs[-self.EXPORT_INTERVALS:]
+
+        out = {
+            "enabled": self.enabled,
+            "verbs": verbs,
+            "steps": {str(k): by for k, by in steps.items()},
+            "windows": {str(k): w for k, w in windows.items()},
+            "intervals": intervals,
+            "intervals_dropped": cat_dropped,
+            "records_dropped": total_dropped,
+        }
+        if clear:
+            self.clear()
         return out
 
-    def _clear_locked(self) -> None:
-        self._verbs.clear()
-        self._steps.clear()
-        self._windows.clear()
-        for c in _CATS:
-            self._ivs[c].clear()
-            self.dropped[c] = 0
+    @property
+    def dropped(self) -> Dict[str, int]:
+        """Per-category drop counts (kept as a property for parity with
+        the old attribute; computed from the rings)."""
+        _, cat_dropped, _, _ = self._drain()
+        return cat_dropped
 
     def clear(self) -> None:
-        with self._lock:
-            self._clear_locked()
+        with self._reg_lock:
+            rings = list(self._rings)
+        if self._core is not None:
+            self._core.clear()
+        for r in rings:
+            r.base = r.cursor
+            r.kind_base = list(r.kind_writes)
 
 
 # -- module singleton (trace.py's lazy-config pattern) ----------------------
@@ -343,8 +630,11 @@ def _init_from_env() -> RpcLedger:
     with _INIT_LOCK:
         if _LEDGER is None:
             from tepdist_tpu.core.service_env import ServiceEnv
+            env = ServiceEnv.get()
             _LEDGER = RpcLedger(
-                enabled=bool(ServiceEnv.get().tepdist_ledger))
+                enabled=bool(env.tepdist_ledger),
+                ring_records=int(getattr(env, "tepdist_ledger_ring", 0)
+                                 or RpcLedger.RING_RECORDS))
     return _LEDGER
 
 
@@ -376,11 +666,23 @@ def active() -> Optional[RpcLedger]:
 
 
 # -- scope constructors (return the shared no-op when disabled) -------------
+#
+# With the native core these return a LedgerScope whose whole lifecycle
+# (ctx save/set on enter, interval record + ctx restore on exit) runs in
+# C — per RPC the scope costs one object allocation and two C calls.
+# The Python _VerbScope/_StepScope/_StepHint classes stay as the
+# fallback path and for direct construction.
 
 def client_scope(verb: str, step: Optional[int] = None):
     led = active()
     if led is None:
         return _NULL_CTX
+    core = led._core
+    if core is not None:
+        code = led._verb_codes.get(verb)
+        if code is None:
+            code = led._intern(verb)
+        return core.scope(_K_CALL, code, -2 if step is None else step)
     return _VerbScope(led, verb, "client", step)
 
 
@@ -388,6 +690,12 @@ def server_scope(verb: str, step: Optional[int] = None):
     led = active()
     if led is None:
         return _NULL_CTX
+    core = led._core
+    if core is not None:
+        code = led._verb_codes.get(verb)
+        if code is None:
+            code = led._intern(verb)
+        return core.scope(_K_HANDLER, code, -2 if step is None else step)
     return _VerbScope(led, verb, "server", step)
 
 
@@ -395,13 +703,20 @@ def step_scope(step: int):
     led = active()
     if led is None:
         return _NULL_CTX
+    core = led._core
+    if core is not None:
+        return core.scope(_K_WINDOW, 0, int(step))
     return _StepScope(led, step)
 
 
 def step_hint(step: Optional[int]):
-    if active() is None or step is None:
+    led = active()
+    if led is None or step is None:
         return _NULL_CTX
-    return _StepHint(step)
+    core = led._core
+    if core is not None:
+        return core.scope(-1, 0, int(step))
+    return _StepHint(led, step)
 
 
 # -- interval math ----------------------------------------------------------
@@ -561,6 +876,7 @@ def merge(snapshots: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     windows: Dict[str, List[float]] = {}
     intervals: Dict[str, List[List[float]]] = {c: [] for c in _CATS}
     dropped: Dict[str, int] = {c: 0 for c in _CATS}
+    records_dropped = 0
     any_enabled = False
     for snap in snapshots:
         if not snap:
@@ -587,6 +903,8 @@ def merge(snapshots: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
             intervals[c].extend(
                 (snap.get("intervals") or {}).get(c, ()))
             dropped[c] += (snap.get("intervals_dropped") or {}).get(c, 0)
+        records_dropped += int(snap.get("records_dropped") or 0)
     return {"enabled": any_enabled, "verbs": verbs, "steps": steps,
             "windows": windows, "intervals": intervals,
-            "intervals_dropped": dropped}
+            "intervals_dropped": dropped,
+            "records_dropped": records_dropped}

@@ -124,6 +124,31 @@ def test_snapshot_clear_resets_ring():
     assert rec.snapshot()["events"] == []
 
 
+def test_head_sampling_sheds_whole_requests():
+    """TEPDIST_FLIGHT_SAMPLE keeps every Nth REQUEST (hash of rid), not
+    every Nth event: a kept request's waterfall stays complete, a shed
+    one contributes only to sampled_out. The wildcard rid '*' always
+    records (engine-wide events must survive sampling)."""
+    rec = FlightRecorder(enabled=True, capacity=256, sample=4)
+    rids = [f"req-{i}" for i in range(32)]
+    for rid in rids:
+        for ev in ("submit", "admit", "decode", "finish"):
+            rec.record(rid, ev)
+    rec.record("*", "restart")
+    snap = rec.snapshot()
+    kept = {e["rid"] for e in snap["events"]} - {"*"}
+    shed = set(rids) - kept
+    assert kept and shed                      # sampling actually split
+    assert "*" in {e["rid"] for e in snap["events"]}
+    # Kept requests are complete; shed requests are counted, not lost.
+    for rid in kept:
+        evs = [e["ev"] for e in snap["events"] if e["rid"] == rid]
+        assert evs == ["submit", "admit", "decode", "finish"]
+    assert snap["sampled_out"] == 4 * len(shed)
+    assert snap["dropped"] == 0
+    assert len(snap["events"]) + snap["sampled_out"] == 4 * len(rids) + 1
+
+
 def test_disabled_module_record_is_noop(private_recorder):
     flight_mod.configure(enabled=False)
     flight_mod.record("r0", "submit")

@@ -155,18 +155,26 @@ def test_disabled_ledger_records_nothing(private_ledger):
     assert snap["verbs"] == {} and snap["steps"] == {}
 
 
-def test_interval_ring_is_bounded():
-    class Tiny(RpcLedger):
-        MAX_INTERVALS = 4
-
-    led = Tiny(enabled=True)
+def test_record_ring_is_bounded():
+    # Per-thread ring of 4 records: 10 serde records leave the newest 4
+    # and count the 6 evicted ones under their gap-table category.
+    led = RpcLedger(enabled=True, ring_records=4)
     for i in range(10):
-        led._add_iv("serde", i, i + 1)
+        led.record_encode(i * 1000, (i + 1) * 1000)
     snap = led.snapshot()
     assert len(snap["intervals"]["serde"]) == 4
     assert snap["intervals_dropped"]["serde"] == 6
-    # Oldest dropped: the survivors are the newest four.
-    assert snap["intervals"]["serde"][0][0] == 6
+    assert snap["records_dropped"] == 6
+    # Oldest dropped: the survivors are the newest four (1us apart).
+    durs = [iv[1] for iv in snap["intervals"]["serde"]]
+    assert durs == [1, 1, 1, 1]
+    assert snap["intervals"]["serde"][-1][0] - \
+        snap["intervals"]["serde"][0][0] == 3
+    # clear() resets both survivors and drop accounting.
+    led.clear()
+    snap = led.snapshot()
+    assert snap["intervals"]["serde"] == []
+    assert snap["intervals_dropped"]["serde"] == 0
 
 
 # ---------------------------------------------------------------------------

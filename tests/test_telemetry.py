@@ -86,7 +86,7 @@ def test_enabled_span_records_fields(private_tracer):
     private_tracer.enabled = True
     before_us = time.time_ns() // 1000
     with trace_mod.span("stage0_fwd", cat="compute", stage=0) as sp:
-        assert isinstance(sp, Span)
+        assert sp is not _NULL_SPAN  # a real recording span
         assert sp.elapsed_ms >= 0.0  # live-readable mid-block
         sp.set(bytes=4096)
     rec = private_tracer.snapshot()[-1]
@@ -122,7 +122,7 @@ def test_configure_toggles_and_rerings():
     try:
         t = trace_mod.configure(enabled=True, capacity=8)
         assert t.enabled and t.capacity == 8
-        assert isinstance(trace_mod.span("y"), Span)
+        assert trace_mod.span("y") is not _NULL_SPAN
         t2 = trace_mod.configure(enabled=False)
         assert t2 is t and trace_mod.span("z") is _NULL_SPAN
     finally:

@@ -1,0 +1,50 @@
+"""One-shot: capture the pre-rebuild ledger outputs as a parity fixture.
+
+Runs the tools/ledger_report.py two-worker fixture against the CURRENT
+ledger implementation and commits the raw snapshot plus every derived
+output (per-verb table, gap table, reconcile verdict) to
+tests/fixtures/ledger_parity.json. tests/test_obs_parity.py replays the
+read-time aggregation over the committed snapshot and asserts the
+rebuilt code reproduces these outputs byte-for-byte.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.ledger_report import run_fixture  # noqa: E402
+
+from tepdist_tpu.telemetry import ledger as led  # noqa: E402
+
+
+def main() -> None:
+    rep = run_fixture(steps=4)
+    snap = rep["_snapshot"]
+    single_ms = rep["single_step_ms"]
+    table = led.gap_table(snap, single_step_ms=single_ms)
+    rec = led.reconcile(table, rep["fidelity_attribution"],
+                        measured_step_ms=None)
+    fixture = {
+        "snapshot": snap,
+        "single_step_ms": single_ms,
+        "gap_table": table,
+        "fidelity_attribution": rep["fidelity_attribution"],
+        "reconcile": rec,
+        "verbs": snap["verbs"],
+    }
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "fixtures",
+        "ledger_parity.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(fixture, f, indent=1, sort_keys=True)
+    print(f"wrote {out}: {len(snap['intervals']['serde'])} serde / "
+          f"{len(snap['intervals']['rpc'])} rpc / "
+          f"{len(snap['intervals']['handler'])} handler intervals, "
+          f"reconcile ok={rec['ok']}")
+
+
+if __name__ == "__main__":
+    main()

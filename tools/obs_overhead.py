@@ -1,0 +1,305 @@
+"""Always-on observability cost harness (ISSUE 16).
+
+Measures the enabled-path cost of all four telemetry instruments and
+gates the three watchlist keys perf_gate carries in DEFAULT_KEYS:
+
+    ledger_overhead_pct        <= 2.0  (% of the two-worker fleet step)
+    trace_enabled_ns_per_span  <= 600  (ns per recorded span)
+    flight_overhead_pct        <= 2.0  (% of a serving burst)
+
+plus an ungated informational line for the metrics registry hot paths
+(counter inc / histogram observe).
+
+Methodology (shared with bench.py's ledger line): a naive A/B cannot
+resolve tens of microseconds of instrument cost inside a multi-threaded
+millisecond-scale workload on a drifting host — an OFF-vs-OFF null
+experiment shows "overhead" of the same magnitude as a real ON run.  So
+every percent-of-workload metric here runs three measurements on one
+warm fixture:
+
+    1. null calibration  — paired OFF/OFF windows; the median absolute
+       pair delta is the host's A/B noise floor for that workload,
+    2. paired A/B        — OFF/ON pairs in ABBA order (drift cancels),
+    3. per-op accounting — record volumes counted from the instrument's
+       own drain, times per-op costs measured in tight in-situ loops.
+
+The reported value is the A/B median when it clears the noise floor,
+else the per-op accounting total; both always ride along, with the
+chosen methodology stamped.  Nanosecond-scale metrics (trace span,
+metrics hot paths) are tight single-threaded loops and need no guard
+beyond median-of-reps.
+
+Usage:
+    python tools/obs_overhead.py                # human-readable table
+    python tools/obs_overhead.py --json         # records to stdout
+    python tools/obs_overhead.py --out FILE     # {"extra": [...]} file,
+                                                # perf_gate --extra ready
+    python tools/obs_overhead.py --check        # exit 1 on any gate RED
+    python tools/obs_overhead.py --skip-flight  # fleet+trace+metrics only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+
+def measure_trace() -> dict:
+    from bench import bench_trace_overhead
+
+    return bench_trace_overhead()
+
+
+def measure_ledger() -> dict:
+    from bench import bench_ledger_overhead
+
+    return bench_ledger_overhead()
+
+
+def measure_flight(ab_pairs: int = 3, null_pairs: int = 2,
+                   n_requests: int = 8) -> dict:
+    """Flight-recorder cost on a serving burst — the only workload that
+    actually records flight events (the training path records none).
+    Same adaptive estimator as the ledger line."""
+    import numpy as np
+
+    import jax
+
+    from tepdist_tpu.models import gpt2
+    from tepdist_tpu.serving import ServingEngine
+    from tepdist_tpu.telemetry import flight
+
+    cfg = gpt2.CONFIGS["test"]
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(params, cfg, slots=4, max_len=32,
+                        max_queue=n_requests + 1, name="obs")
+    rng = np.random.RandomState(0)
+    rec = flight.recorder()
+    seq = [0]
+
+    def burst_ms(on: bool) -> float:
+        flight.configure(enabled=on)
+        tag = f"b{seq[0]}"
+        seq[0] += 1
+        for i in range(n_requests):
+            t = int(rng.randint(3, 13))
+            m = int(rng.randint(2, 8))
+            eng.submit(f"{tag}-{i}",
+                       rng.randint(0, cfg.vocab_size,
+                                   size=t).astype(np.int32),
+                       max_new_tokens=m)
+        t0 = time.perf_counter()
+        eng.run_until_idle()
+        ms = (time.perf_counter() - t0) * 1e3
+        rec.clear()
+        return ms
+
+    try:
+        burst_ms(False)               # warmup absorbs compiles
+        burst_ms(True)
+
+        null_pcts = []
+        for _ in range(null_pairs):
+            a = burst_ms(False)
+            b = burst_ms(False)
+            null_pcts.append((b - a) / a * 100.0 if a else 0.0)
+        noise_floor = statistics.median(abs(v) for v in null_pcts)
+
+        ab_pcts = []
+        off_walls = []
+        for p in range(ab_pairs):
+            if p % 2 == 0:
+                off = burst_ms(False)
+                on = burst_ms(True)
+            else:
+                on = burst_ms(True)
+                off = burst_ms(False)
+            off_walls.append(off)
+            ab_pcts.append((on - off) / off * 100.0 if off else 0.0)
+        ab_median = statistics.median(ab_pcts)
+        off_ms = statistics.median(off_walls)
+
+        # Accounting: events per burst from the recorder's own snapshot,
+        # per-event cost from a tight loop on the real record() path.
+        flight.configure(enabled=True)
+        burst_start = time.perf_counter()
+        tag = f"acct{seq[0]}"
+        seq[0] += 1
+        for i in range(n_requests):
+            t = int(rng.randint(3, 13))
+            m = int(rng.randint(2, 8))
+            eng.submit(f"{tag}-{i}",
+                       rng.randint(0, cfg.vocab_size,
+                                   size=t).astype(np.int32),
+                       max_new_tokens=m)
+        eng.run_until_idle()
+        acct_ms = (time.perf_counter() - burst_start) * 1e3
+        snap = rec.snapshot()
+        events = len(snap["events"]) + snap["dropped"] + snap["sampled_out"]
+        rec.clear()
+
+        # Min-of-reps per-event cost (additive-noise argument: the
+        # minimum of a tight loop is the true cost), and the floor
+        # across OFF bursts as denominator — both choices keep the
+        # ratio stable run to run on a loaded host.
+        n = 5000
+        reps = []
+        for _ in range(4):
+            t0 = time.perf_counter_ns()
+            for _ in range(n):
+                flight.record("obs-cal", "decode", tok=7)
+            reps.append((time.perf_counter_ns() - t0) / n)
+            rec.clear()
+        per_event_ns = min(reps)
+
+        off_floor_ms = min(off_walls) if off_walls else acct_ms
+        accounted_pct = (events * per_event_ns / 1e6) / off_floor_ms \
+            * 100.0 if off_floor_ms else 0.0
+    finally:
+        flight.configure(enabled=True)   # default ON
+
+    # Same coherence rule as bench.bench_ledger_overhead: the A/B
+    # median is only readable when it clears the null floor AND no pair
+    # lands on the wrong side of zero — one inverted pair means noise
+    # operates at the scale of the claimed effect.
+    if ab_median <= noise_floor:
+        ab_unreadable = "below host noise floor"
+    elif min(ab_pcts) <= 0.0:
+        ab_unreadable = "pairs straddle zero"
+    else:
+        ab_unreadable = None
+    pct = max(accounted_pct if ab_unreadable else ab_median, 0.0)
+    methodology = ("ab_paired_bursts" if ab_unreadable is None
+                   else f"per_op_accounting (A/B {ab_unreadable})")
+    return {
+        "metric": "flight_overhead_pct",
+        "value": round(pct, 2),
+        "unit": "% of serving burst (flight enabled vs off)",
+        "methodology": methodology,
+        "burst_off_ms": round(off_ms, 1),
+        "ab_median_pct": round(ab_median, 2),
+        "ab_pair_pcts": [round(v, 2) for v in ab_pcts],
+        "noise_floor_pct": round(noise_floor, 2),
+        "accounted_pct": round(accounted_pct, 3),
+        "events_per_burst": events,
+        "per_event_ns": round(per_event_ns, 1),
+        "gate_below_2pct": bool(pct <= 2.0),
+    }
+
+
+def measure_metrics() -> dict:
+    """Metrics registry hot paths: counter inc and histogram observe.
+    Informational (no watchlist gate) — these sit on the same serving
+    hot paths the flight gate already bounds end-to-end."""
+    from tepdist_tpu.telemetry.metrics import metrics
+
+    reg = metrics()
+    n = 50000
+    c = reg.counter("obs_overhead_cal")
+    reps = []
+    for _ in range(3):
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            c.inc()
+        reps.append((time.perf_counter_ns() - t0) / n)
+    counter_ns = statistics.median(reps)
+
+    h = reg.histogram("obs_overhead_cal_ms")
+    reps = []
+    for _ in range(3):
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            h.observe(1.25)
+        reps.append((time.perf_counter_ns() - t0) / n)
+    histogram_ns = statistics.median(reps)
+
+    return {
+        "metric": "metrics_hot_ns",
+        "value": round(histogram_ns, 1),
+        "unit": "ns/observe",
+        "counter_inc_ns": round(counter_ns, 1),
+        "histogram_observe_ns": round(histogram_ns, 1),
+    }
+
+
+GATES = (
+    ("ledger_overhead_pct", "gate_below_2pct"),
+    ("trace_overhead", "gate_below_600ns"),
+    ("flight_overhead_pct", "gate_below_2pct"),
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        "obs_overhead", description="always-on telemetry cost harness")
+    ap.add_argument("--json", action="store_true",
+                    help="print records as JSON lines")
+    ap.add_argument("--out", help="write {'extra': [...]} JSON "
+                                  "(perf_gate --extra compatible)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if any overhead gate is RED")
+    ap.add_argument("--skip-flight", action="store_true",
+                    help="skip the serving-burst flight measurement")
+    args = ap.parse_args(argv)
+
+    records = []
+    records.append(measure_trace())
+    records.append(measure_ledger())
+    if not args.skip_flight:
+        records.append(measure_flight())
+    records.append(measure_metrics())
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"extra": records}, f, indent=1)
+
+    failures = []
+    by_metric = {r.get("metric"): r for r in records}
+    for metric, gate_key in GATES:
+        r = by_metric.get(metric)
+        if r is None:
+            continue
+        if not r.get(gate_key, False):
+            failures.append(f"{metric}: {gate_key} is RED "
+                            f"(value {r.get('value')})")
+
+    if args.json:
+        for r in records:
+            print(json.dumps(r))
+    else:
+        print("always-on observability cost")
+        print("-" * 60)
+        for r in records:
+            gate = ""
+            for metric, gate_key in GATES:
+                if r.get("metric") == metric:
+                    gate = " GREEN" if r.get(gate_key) else " RED"
+            meth = r.get("methodology")
+            meth_s = f"  [{meth}]" if meth else ""
+            print(f"{r.get('metric'):28s} {r.get('value')} "
+                  f"{r.get('unit', '')}{gate}{meth_s}")
+        key_fields = ("ab_median_pct", "noise_floor_pct", "accounted_pct",
+                      "trace_enabled_ns_per_span")
+        for r in records:
+            parts = [f"{k}={r[k]}" for k in key_fields if k in r]
+            if parts:
+                print(f"    {r.get('metric')}: {', '.join(parts)}")
+
+    if failures:
+        for f_ in failures:
+            print(f"OVERHEAD GATE: {f_}", file=sys.stderr)
+        return 1 if args.check else 0
+    if args.check:
+        print("overhead gates: all GREEN")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

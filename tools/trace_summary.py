@@ -105,8 +105,10 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
         "workers": workers,
         "metrics": meta.get("metrics"),
     }
-    if meta.get("spans_dropped"):
-        out["spans_dropped"] = meta["spans_dropped"]
+    for key in ("spans_dropped", "ledger_dropped", "flight_dropped",
+                "flight_sampled_out"):
+        if meta.get(key):
+            out[key] = meta[key]
     fid = _fidelity_section(trace)
     if fid is not None:
         out["fidelity"] = fid
@@ -277,6 +279,23 @@ def main() -> None:
         print(f"WARNING: LOSSY trace — span ring overflowed ({drops}); "
               f"missing spans read as idle time "
               f"(raise TEPDIST_TRACE_CAPACITY)")
+    if s.get("ledger_dropped"):
+        drops = ", ".join(f"{k}={v}"
+                          for k, v in sorted(s["ledger_dropped"].items()))
+        print(f"WARNING: LOSSY ledger — ring overflowed ({drops} records); "
+              f"gap-table sums undercount "
+              f"(raise TEPDIST_LEDGER_RING)")
+    if s.get("flight_dropped"):
+        drops = ", ".join(f"{k}={v}"
+                          for k, v in sorted(s["flight_dropped"].items()))
+        print(f"WARNING: LOSSY flight recorder — ring overflowed ({drops} "
+              f"events); request waterfalls have missing hops "
+              f"(raise TEPDIST_FLIGHT_CAPACITY)")
+    if s.get("flight_sampled_out"):
+        drops = ", ".join(f"{k}={v}" for k, v in
+                          sorted(s["flight_sampled_out"].items()))
+        print(f"note: flight head-sampling active — {drops} events shed "
+              f"by TEPDIST_FLIGHT_SAMPLE (counted, not lost)")
     print("per-category time:")
     for cat, ms in s["category_ms"].items():
         print(f"  {cat:<12} {ms:10.3f} ms")
