@@ -51,6 +51,13 @@ class TepdistSession:
         self._batch_leaf_idx: Sequence[int] = ()
         self._step_count = 0
         self.fetch_every = ServiceEnv.get().fetch_resource_var_steps
+        # Training-health sentinel (telemetry/watchtower.py): the loss is
+        # already on host each run(), so the NaN watchdog + loss-spike
+        # detector cost a few float compares. Advisory unless
+        # TEPDIST_WATCH_HALT promotes them.
+        from tepdist_tpu.telemetry.watchtower import TrainingSentinel
+        self.sentinel = TrainingSentinel(
+            halt=ServiceEnv.get().tepdist_watch_halt)
 
     # ------------------------------------------------------------------
     def compile_train_step(self, step_fn: Callable, params, opt_state,
@@ -218,8 +225,9 @@ class TepdistSession:
             self.handle, inline_args=inline,
             fetch_resource_variables=fetch)
         self._step_count += 1
-        loss = result["outputs"][0]
-        return float(np.asarray(loss))
+        loss = float(np.asarray(result["outputs"][0]))
+        self.sentinel.observe(self._step_count - 1, loss)
+        return loss
 
     # ------------------------------------------------------------------
     def compile_generate(self, gen_fn: Callable, params,

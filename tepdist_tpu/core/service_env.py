@@ -155,6 +155,22 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
      "every Nth request's waterfall (hash of request id), shed the rest "
      "at record time and count them as sampled_out. 1 = record all; "
      "the wildcard rid '*' bypasses sampling (engine-wide events)"),
+    ("TEPDIST_WATCH", bool, False, "watchtower poller thread "
+     "(telemetry/watchtower.py): continuously polls every worker's "
+     "GetTelemetryDelta, maintains per-worker rolling step-time/RTT "
+     "digests, and raises typed straggler/fleet-shape/SLO-burn alerts. "
+     "The training-health sentinel (NaN watchdog + loss-spike) is "
+     "always on regardless — it costs a few float compares per step"),
+    ("TEPDIST_WATCH_INTERVAL", float, 2.0, "watchtower poll interval in "
+     "seconds (per-worker GetTelemetryDelta cadence)"),
+    ("TEPDIST_WATCH_HALT", str, "", "promote sentinel alerts from "
+     "advisory to halting: 'nan' fences the fleet via the AbortStep "
+     "path and raises WatchHalt on a non-finite loss; '' (default) "
+     "records the alert and keeps training"),
+    ("TEPDIST_SLO_FILE", str, "", "path to slo.toml declaring SLO "
+     "targets (step_time_ms percentiles, per-class serve TTFT/token "
+     "tails, error rates) for the watchtower's multi-window burn-rate "
+     "engine; empty = no SLO evaluation"),
     # --- static analysis --------------------------------------------------
     ("TEPDIST_VERIFY_PLAN", bool,
      "pytest" in sys.modules or "PYTEST_CURRENT_TEST" in os.environ,

@@ -178,6 +178,23 @@ class TepdistClient:
         header["offset_us"] = header.get("now_us", t1) - (t0 + t1) / 2
         return header
 
+    def get_telemetry_delta(self, cursors: Optional[Dict[str, Any]] = None,
+                            spans: bool = False) -> Dict[str, Any]:
+        """Incremental telemetry read (watchtower poll verb): pass the
+        ``cursors`` dict from the previous response (None for a first
+        read from the ring bases) and receive only records written
+        since, with exact drop counters. A pure non-consuming read —
+        naturally idempotent, no idem token. Same NTP-style clock
+        annotation as get_telemetry."""
+        t0 = time.time_ns() // 1000
+        resp = self.call("GetTelemetryDelta",
+                         {"cursors": cursors, "spans": bool(spans)})
+        t1 = time.time_ns() // 1000
+        header, _ = protocol.unpack(resp)
+        header["rtt_us"] = t1 - t0
+        header["offset_us"] = header.get("now_us", t1) - (t0 + t1) / 2
+        return header
+
     # -- plan building --------------------------------------------------
     def build_execution_plan(
         self,
@@ -333,7 +350,8 @@ class TepdistClient:
                        prompt, *, max_new_tokens: int, greedy: bool = True,
                        temperature: float = 1.0, top_k: int = 0,
                        seed: int = 0,
-                       deadline_ms: Optional[float] = None
+                       deadline_ms: Optional[float] = None,
+                       slo_class: str = "default"
                        ) -> Dict[str, Any]:
         meta, blob = protocol.encode_literal(
             np.asarray(prompt, np.int32).reshape(-1))
@@ -342,7 +360,8 @@ class TepdistClient:
             "prompt": meta, "max_new_tokens": int(max_new_tokens),
             "greedy": bool(greedy), "temperature": float(temperature),
             "top_k": int(top_k), "seed": int(seed),
-            "deadline_ms": deadline_ms}, [blob])
+            "deadline_ms": deadline_ms,
+            "slo_class": str(slo_class)}, [blob])
         header, _ = protocol.unpack(resp)
         return header
 

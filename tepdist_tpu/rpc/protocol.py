@@ -32,6 +32,14 @@ RPC surface (method -> reference RPC):
                            ring buffer + metrics snapshot, stamped with the
                            worker's clock so the client can align fleets'
                            timelines — telemetry/export.py)
+  GetTelemetryDelta     -> (no reference analogue: cursor-based incremental
+                           read of the telemetry rings — the caller passes
+                           its last-seen per-ring cursors, the server
+                           returns only NEW records plus exact drop
+                           counters. Non-consuming: snapshots and the
+                           final trace dump still see everything. The
+                           watchtower poller lives on this verb —
+                           telemetry/watchtower.py)
   LoadServable          -> (no reference analogue: ships a model config +
                            params and starts a continuous-batching serving
                            engine — tepdist_tpu/serving/)
@@ -84,6 +92,7 @@ METHODS = [
     "AbortStep",
     "Ping",
     "GetTelemetry",
+    "GetTelemetryDelta",
     "LoadServable",
     "SubmitRequest",
     "PollResult",

@@ -46,6 +46,9 @@ DEADLINES = {
     "Ping": 10.0,
     "AbortStep": 15.0,
     "GetTelemetry": 30.0,
+    # Delta polls are small and frequent (watchtower interval): a poll
+    # that cannot answer in 15 s is itself a straggler signal.
+    "GetTelemetryDelta": 15.0,
     "InitMeshTopology": 30.0,
     "TransferVarArgMap": 30.0,
     "TransferToServerHost": 120.0,

@@ -38,6 +38,7 @@ from tepdist_tpu.runtime import faults
 from tepdist_tpu.telemetry import flight
 from tepdist_tpu.telemetry import ledger as wire_ledger
 from tepdist_tpu.telemetry import metrics, span
+from tepdist_tpu.telemetry import watchtower
 
 log = logging.getLogger("tepdist.server")
 
@@ -1322,7 +1323,46 @@ class TepdistServicer:
             "metrics": telemetry.metrics().snapshot(),
             "ledger": ledger_snap,
             "flight": flight_snap,
+            "alerts": watchtower.active_alerts(),
         })
+
+    def GetTelemetryDelta(self, request: bytes, context=None) -> bytes:
+        """Cursor-based incremental telemetry read (the watchtower's
+        poll verb, telemetry/watchtower.py). The caller passes the
+        ``cursors`` dict from its previous response (or omits it for a
+        first read from the ring bases); the reply carries only records
+        written since, plus EXACT drop counters for anything the rings
+        overwrote between polls. Non-consuming — ring bases are
+        untouched, so full snapshots and the final trace dump still see
+        everything the rings hold. ``spans=true`` additionally streams
+        trace-span deltas (off by default: the watchtower wants ledger
+        rows and metrics, not span payloads)."""
+        from tepdist_tpu import telemetry
+
+        header, _ = protocol.unpack(request)
+        cursors = header.get("cursors") or {}
+        ledger_delta, led_state = wire_ledger.ledger().delta(
+            cursors.get("ledger"))
+        flight_delta, fl_state = flight.recorder().delta(
+            cursors.get("flight"))
+        out = {
+            "ok": True,
+            "task_index": self.task_index,
+            "now_us": time.time_ns() // 1000,
+            "enabled": telemetry.enabled(),
+            "global_step": self.global_step,
+            "ledger": ledger_delta,
+            "flight": flight_delta,
+            "metrics": telemetry.metrics().snapshot(),
+            "alerts": watchtower.active_alerts(),
+            "cursors": {"ledger": led_state, "flight": fl_state},
+        }
+        if header.get("spans"):
+            trace_delta, tr_state = telemetry.tracer().delta(
+                cursors.get("trace"))
+            out["trace"] = trace_delta
+            out["cursors"]["trace"] = tr_state
+        return protocol.pack(out)
 
     # -- serving verbs (tepdist_tpu/serving/) ---------------------------
     def _servable(self, sid: str):
@@ -1422,7 +1462,8 @@ class TepdistServicer:
             temperature=float(header.get("temperature", 1.0)),
             top_k=int(header.get("top_k", 0)),
             seed=int(header.get("seed", 0)),
-            deadline_ms=header.get("deadline_ms"))
+            deadline_ms=header.get("deadline_ms"),
+            slo_class=str(header.get("slo_class", "default")))
         return self._idem_put(header, protocol.pack({"ok": True, **out}))
 
     def PollResult(self, request: bytes, context=None) -> bytes:

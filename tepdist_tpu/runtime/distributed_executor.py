@@ -267,6 +267,26 @@ class DistributedPipelineSession:
         # failure detection at all — SURVEY §5.3).
         from tepdist_tpu.runtime.health import HealthMonitor
         self.health = HealthMonitor(self.clients)
+        # Training-health sentinel: always on (the loss is already on
+        # host each step, the check is a few float compares). The poller
+        # thread is opt-in via TEPDIST_WATCH.
+        from tepdist_tpu.core.service_env import ServiceEnv
+        from tepdist_tpu.telemetry import watchtower
+        env = ServiceEnv.get()
+        self.sentinel = watchtower.TrainingSentinel(
+            halt=env.tepdist_watch_halt)
+        self._last_worker_ms: Dict[int, float] = {}
+        self.watchtower: Optional[watchtower.Watchtower] = None
+        if env.tepdist_watch:
+            self.watchtower = watchtower.Watchtower(
+                clients=[self.clients[ti]
+                         for ti in sorted(self.clients)],
+                interval_s=env.tepdist_watch_interval,
+                slo_path=env.tepdist_slo_file or None,
+                halt=env.tepdist_watch_halt)
+            self.watchtower.sentinel = self.sentinel
+            watchtower.set_active(self.watchtower)
+            self.watchtower.start()
 
     def _wired_cots(self) -> List[List[int]]:
         out = []
@@ -325,9 +345,24 @@ class DistributedPipelineSession:
         # master_step span gives the fidelity attribution the same frame:
         # without it, host serde on the push path (before any worker's
         # run_step opens) would be clamped out of the step window.
-        with wire_ledger.step_scope(self._step), \
-                span("master_step", cat="step", step=self._step):
-            return self._step_body(*batch)
+        step = self._step
+        self._last_worker_ms = {}
+        t0 = time.monotonic()
+        with wire_ledger.step_scope(step), \
+                span("master_step", cat="step", step=step):
+            loss = self._step_body(*batch)
+        # Watchtower feed: step wall + per-worker dispatch walls (the
+        # straggler scorer's primary signal) — one histogram observe and
+        # a deque append per step when the watchtower is active.
+        wall_ms = (time.monotonic() - t0) * 1e3
+        m = metrics()
+        m.histogram("step_time_ms").observe(wall_ms)
+        for ti, ms in self._last_worker_ms.items():
+            m.histogram(f"worker_step_ms:{ti}").observe(ms)
+        from tepdist_tpu.telemetry import watchtower
+        watchtower.observe_step(step, wall_ms,
+                                dict(self._last_worker_ms))
+        return loss
 
     def _step_body(self, *batch) -> float:
         from tepdist_tpu.core.service_env import ServiceEnv
@@ -362,6 +397,7 @@ class DistributedPipelineSession:
         threads: List[threading.Thread] = []
 
         def run(ti, client, header, blobs):
+            t0 = time.monotonic()
             try:
                 resp = client.call("ExecuteStepSlice", header, blobs)
                 r, _ = protocol.unpack(resp)
@@ -370,6 +406,7 @@ class DistributedPipelineSession:
                         f"worker {ti} dropped step {step}: stale plan "
                         f"generation {r.get('stale_plan_gen')}")
                 results[ti] = r
+                self._last_worker_ms[ti] = (time.monotonic() - t0) * 1e3
             except Exception as e:  # noqa: BLE001
                 errors[ti] = e
 
@@ -465,9 +502,11 @@ class DistributedPipelineSession:
         errors: Dict[int, Exception] = {}
 
         def run(ti, client):
+            t0 = time.monotonic()
             try:
                 resp = client.call("ExecuteRemotePlan", {"step": step})
                 results[ti], _ = protocol.unpack(resp)
+                self._last_worker_ms[ti] = (time.monotonic() - t0) * 1e3
             except Exception as e:  # noqa: BLE001
                 errors[ti] = e
 
@@ -485,6 +524,7 @@ class DistributedPipelineSession:
         return self._finish_step(results)
 
     def _finish_step(self, results: Dict[int, dict]) -> float:
+        from tepdist_tpu.telemetry.watchtower import WatchHalt
         self._step += 1
         self._redispatch_attempts = 0   # a full step succeeded: reset cap
         self._step_attempts = 0
@@ -492,7 +532,21 @@ class DistributedPipelineSession:
         if (self._elastic and self._autosave_every > 0
                 and self._step % self._autosave_every == 0):
             self.save()
-        return float(sum(losses) / max(len(losses), 1))
+        loss = float(sum(losses) / max(len(losses), 1))
+        # Training-health sentinel: advisory alerts publish to the board
+        # and keep training; in halt mode (TEPDIST_WATCH_HALT=nan) a
+        # non-finite loss fences the fleet through the AbortStep path —
+        # the same fence the transient-fault retry uses, so workers
+        # return at fence latency and stay restartable — before the halt
+        # propagates to the caller.
+        try:
+            self.sentinel.observe(self._step - 1, loss)
+        except WatchHalt:
+            log.error("watchtower halt at step %d (loss=%r): fencing "
+                      "fleet", self._step - 1, loss)
+            self._reset_fleet_step()
+            raise
+        return loss
 
     # ------------------------------------------------------------------
     # Transient-vs-permanent recovery ladder (ISSUE pr3): a mid-step fault
@@ -738,6 +792,11 @@ class DistributedPipelineSession:
         return sess
 
     def close(self) -> None:
+        if self.watchtower is not None:
+            from tepdist_tpu.telemetry import watchtower
+            self.watchtower.stop()
+            if watchtower.get_active() is self.watchtower:
+                watchtower.set_active(None)
         self.health.stop()
         for c in self.clients.values():
             c.close()

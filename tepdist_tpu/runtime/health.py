@@ -101,6 +101,12 @@ class HealthMonitor:
                     m = metrics()
                     m.gauge(f"heartbeat_rtt_ms:{ti}").set(rtt_ms)
                     m.histogram("heartbeat_rtt_ms").observe(rtt_ms)
+                    # Per-worker RTT histogram: trace_summary's health
+                    # section prints p50/p95/p99 per worker, and the
+                    # watchtower's straggler scorer reads the per-worker
+                    # distribution (the pooled histogram can't attribute
+                    # a tail to a worker).
+                    m.histogram(f"heartbeat_rtt_ms:{ti}").observe(rtt_ms)
                 status[ti] = ok
             except Exception as e:  # noqa: BLE001
                 status[ti] = False

@@ -92,7 +92,8 @@ class ServeRequest:
     top_k: int = 0
     seed: int = 0
     deadline_ms: Optional[float] = None
-    state: str = "queued"
+    slo_class: str = "default"       # SLO class (watchtower burn-rate
+    state: str = "queued"            # targets key per-class tails)
     tokens: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
     error: Optional[str] = None
@@ -194,10 +195,15 @@ class ServingEngine:
     def submit(self, rid: str, prompt, *, max_new_tokens: int,
                greedy: bool = True, temperature: float = 1.0,
                top_k: int = 0, seed: int = 0,
-               deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+               deadline_ms: Optional[float] = None,
+               slo_class: str = "default") -> Dict[str, Any]:
         """Admission control happens here (bounded queue, validation,
         duplicate dedup); deadline expiry happens at slot-assignment
-        time. Returns {"status": queued|rejected|duplicate, ...}."""
+        time. Returns {"status": queued|rejected|duplicate, ...}.
+        ``slo_class`` tags the request's latency/error metrics with a
+        per-class suffix (``serve_ttft_ms:<class>`` …) so slo.toml
+        targets can hold interactive traffic to a tighter tail than
+        batch traffic (telemetry/watchtower.py)."""
         m = metrics()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         now = time.monotonic()
@@ -222,6 +228,7 @@ class ServingEngine:
                 flight.record(rid, "draining", gen=self.gen)
                 return {"status": "draining"}
             m.counter("serve_requests_submitted").inc()
+            m.counter(f"serve_requests_submitted:{slo_class}").inc()
             err = None
             if prompt.size == 0:
                 err = "empty prompt"
@@ -237,7 +244,7 @@ class ServingEngine:
                 rid=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
                 greedy=bool(greedy), temperature=float(temperature),
                 top_k=int(top_k), seed=int(seed), deadline_ms=deadline_ms,
-                t_submit=now,
+                slo_class=str(slo_class), t_submit=now,
                 t_deadline=(now + deadline_ms / 1e3
                             if deadline_ms is not None else None))
             self._reqs[rid] = r
@@ -245,6 +252,7 @@ class ServingEngine:
                 r.state = "rejected"
                 r.error = err
                 m.counter("serve_requests_rejected").inc()
+                m.counter(f"serve_requests_rejected:{r.slo_class}").inc()
                 flight.record(rid, "reject", gen=self.gen, reason=err)
                 return {"status": "rejected", "error": err}
             flight.record(rid, "queue", gen=self.gen,
@@ -369,6 +377,8 @@ class ServingEngine:
                     r.error = f"deadline {r.deadline_ms} ms passed in queue"
                     r.t_done = time.monotonic()
                     m.counter("serve_requests_expired").inc()
+                    m.counter(
+                        f"serve_requests_expired:{r.slo_class}").inc()
                     flight.record(rid, "expire", gen=self.gen)
                     self._cv.notify_all()
                     continue
@@ -449,8 +459,10 @@ class ServingEngine:
             r.pos = int(r.prompt.size)
             flight.record(r.rid, "first_token", gen=self.gen)
             m.counter("serve_tokens").inc()
-            m.histogram("serve_ttft_ms").observe(
-                (r.t_first - r.t_submit) * 1e3)
+            ttft_ms = (r.t_first - r.t_submit) * 1e3
+            m.histogram("serve_ttft_ms").observe(ttft_ms)
+            m.histogram(
+                f"serve_ttft_ms:{r.slo_class}").observe(ttft_ms)
             if r.ttft_span is not None:
                 r.ttft_span.__exit__(None, None, None)
                 r.ttft_span = None
@@ -516,8 +528,10 @@ class ServingEngine:
                           chunks=r.chunks)
             m.counter("serve_prefills").inc()
             m.counter("serve_tokens").inc()
-            m.histogram("serve_ttft_ms").observe(
-                (r.t_first - r.t_submit) * 1e3)
+            ttft_ms = (r.t_first - r.t_submit) * 1e3
+            m.histogram("serve_ttft_ms").observe(ttft_ms)
+            m.histogram(
+                f"serve_ttft_ms:{r.slo_class}").observe(ttft_ms)
             if r.ttft_span is not None:
                 r.ttft_span.__exit__(None, None, None)
                 r.ttft_span = None
@@ -599,6 +613,12 @@ class ServingEngine:
         record = flight.record
         tokens_inc = m.counter("serve_tokens").inc
         token_ms_observe = m.histogram("serve_token_ms").observe
+        # Per-class token histograms, bound once per decode step per
+        # class present in the batch (not per token — registry lookups
+        # are measurable at token rate).
+        cls_observe = {
+            cls: m.histogram(f"serve_token_ms:{cls}").observe
+            for cls in {r.slo_class for r in batch}}
         n_batch = len(batch)
         with self._cv:
             for r, tok_i in zip(batch, picked):
@@ -612,6 +632,7 @@ class ServingEngine:
                        pos=r.pos, batch=n_batch)
                 tokens_inc()
                 token_ms_observe(step_ms)
+                cls_observe[r.slo_class](step_ms)
                 if len(r.tokens) >= r.max_new_tokens:
                     self._finish_locked(r)
             self._cv.notify_all()
@@ -656,6 +677,7 @@ class ServingEngine:
             r.t_done = time.monotonic()
             flight.record(r.rid, "fail", gen=self.gen, reason=err)
             m.counter("serve_requests_failed").inc()
+            m.counter(f"serve_requests_failed:{r.slo_class}").inc()
         self._queue.clear()
         if self.kv_mode == "paged":
             self._clear_prefix_locked()

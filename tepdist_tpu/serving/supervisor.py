@@ -77,6 +77,7 @@ class _JournalEntry:
     top_k: int
     seed: int
     deadline_ms: Optional[float]
+    slo_class: str = "default"
     prefix: List[int] = dataclasses.field(default_factory=list)
     replays: int = 0
 
@@ -215,7 +216,8 @@ class ServingSupervisor:
                     temperature=float(kwargs.get("temperature", 1.0)),
                     top_k=int(kwargs.get("top_k", 0)),
                     seed=int(kwargs.get("seed", 0)),
-                    deadline_ms=kwargs.get("deadline_ms"))
+                    deadline_ms=kwargs.get("deadline_ms"),
+                    slo_class=str(kwargs.get("slo_class", "default")))
             return out
 
     def cancel(self, rid: str) -> bool:
@@ -357,7 +359,8 @@ class ServingSupervisor:
                     e.rid, prompt,
                     max_new_tokens=e.max_new_tokens - len(e.prefix),
                     greedy=e.greedy, temperature=e.temperature,
-                    top_k=e.top_k, seed=e.seed, deadline_ms=e.deadline_ms)
+                    top_k=e.top_k, seed=e.seed, deadline_ms=e.deadline_ms,
+                    slo_class=e.slo_class)
                 e.replays += 1
                 metrics().counter("requests_replayed").inc()
                 flight.record(e.rid, "replay", gen=self.restarts,

@@ -41,3 +41,10 @@ from tepdist_tpu.telemetry import fidelity  # noqa: F401
 from tepdist_tpu.telemetry import flight  # noqa: F401
 from tepdist_tpu.telemetry import ledger  # noqa: F401
 from tepdist_tpu.telemetry import observatory  # noqa: F401
+from tepdist_tpu.telemetry.watchtower import (  # noqa: F401
+    HealthAlert,
+    TrainingSentinel,
+    WatchHalt,
+    Watchtower,
+    active_alerts,
+)

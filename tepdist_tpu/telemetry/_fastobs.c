@@ -587,6 +587,90 @@ fail:
     return NULL;
 }
 
+static PyObject *LedgerCore_drain_since(LedgerCoreObject *self,
+                                        PyObject *cursors) {
+    /* drain_since(cursors) -> (records, new_cursors, dropped).
+     *
+     * Cursor-based incremental read for the watchtower delta stream
+     * (ISSUE 17): ``cursors`` is the per-ring cursor vector from the
+     * previous call (ring indices are stable — ``all`` is append-only,
+     * dead threads' rings are parked for adoption, never removed).  A
+     * ring beyond the vector's length is new to the caller and reads
+     * from its base.  Unlike drain(), nothing is consumed and base is
+     * untouched, so full snapshots and the final trace dump still see
+     * everything; ``dropped`` counts exactly the records that were
+     * overwritten between the caller's cursor and the oldest readable
+     * record (records below base were clear()ed, not dropped). */
+    if (!PyList_Check(cursors)) {
+        PyErr_SetString(PyExc_TypeError, "drain_since(cursors: list[int])");
+        return NULL;
+    }
+    Py_ssize_t ncur = PyList_GET_SIZE(cursors);
+    PyObject *recs = PyList_New(0);
+    if (recs == NULL)
+        return NULL;
+    PyObject *newc = PyList_New(self->n_all);
+    if (newc == NULL) {
+        Py_DECREF(recs);
+        return NULL;
+    }
+    int64_t dropped = 0;
+    for (Py_ssize_t ri = 0; ri < self->n_all; ri++) {
+        LRing *r = self->all[ri];
+        int64_t cur = r->cursor;
+        int64_t prev = -1;
+        if (ri < ncur) {
+            prev = PyLong_AsLongLong(PyList_GET_ITEM(cursors, ri));
+            if (prev == -1 && PyErr_Occurred())
+                goto fail;
+        }
+        int64_t p = prev > r->base ? prev : r->base;
+        if (p > cur)
+            p = cur;
+        int64_t lo = p;
+        if (cur - r->cap > lo)
+            lo = cur - r->cap;
+        dropped += lo - p;
+        for (int64_t c = lo; c < cur; c++) {
+            const int64_t *slot = r->data + (c % r->phys) * LSTRIDE;
+            PyObject *t = PyTuple_New(LSTRIDE);
+            if (t == NULL)
+                goto fail;
+            for (int j = 0; j < LSTRIDE; j++) {
+                PyObject *num = PyLong_FromLongLong(slot[j]);
+                if (num == NULL) {
+                    Py_DECREF(t);
+                    goto fail;
+                }
+                PyTuple_SET_ITEM(t, j, num);
+            }
+            if (PyList_Append(recs, t) < 0) {
+                Py_DECREF(t);
+                goto fail;
+            }
+            Py_DECREF(t);
+        }
+        PyObject *num = PyLong_FromLongLong(cur);
+        if (num == NULL)
+            goto fail;
+        PyList_SET_ITEM(newc, ri, num);
+    }
+    {
+        PyObject *nd = PyLong_FromLongLong(dropped);
+        if (nd == NULL)
+            goto fail;
+        PyObject *out = PyTuple_Pack(3, recs, newc, nd);
+        Py_DECREF(recs);
+        Py_DECREF(newc);
+        Py_DECREF(nd);
+        return out;
+    }
+fail:
+    Py_DECREF(recs);
+    Py_DECREF(newc);
+    return NULL;
+}
+
 static PyObject *LedgerCore_clear(LedgerCoreObject *self, PyObject *noarg) {
     (void)noarg;
     for (Py_ssize_t i = 0; i < self->n_all; i++) {
@@ -638,6 +722,8 @@ static PyMethodDef LedgerCore_methods[] = {
      "scope(kind, code, step) -> LedgerScope (kind -1 = tag-only)."},
     {"drain", (PyCFunction)LedgerCore_drain, METH_NOARGS,
      "-> (records, kind_lost)"},
+    {"drain_since", (PyCFunction)LedgerCore_drain_since, METH_O,
+     "drain_since(cursors) -> (records, new_cursors, dropped)"},
     {"clear", (PyCFunction)LedgerCore_clear, METH_NOARGS, NULL},
     {"dropped", (PyCFunction)LedgerCore_dropped, METH_NOARGS, NULL},
     {"ring_count", (PyCFunction)LedgerCore_ring_count, METH_NOARGS, NULL},
@@ -1026,6 +1112,83 @@ fail:
     return NULL;
 }
 
+static PyObject *TraceCore_drain_since(TraceCoreObject *self,
+                                       PyObject *cursors) {
+    /* drain_since(cursors) -> (records, new_cursors, dropped): the
+     * cursor-parameterized counterpart of drain() (same tuple shape),
+     * for incremental watchtower reads — see LedgerCore_drain_since
+     * for the cursor/base/drop contract. */
+    if (!PyList_Check(cursors)) {
+        PyErr_SetString(PyExc_TypeError, "drain_since(cursors: list[int])");
+        return NULL;
+    }
+    Py_ssize_t ncur = PyList_GET_SIZE(cursors);
+    PyObject *recs = PyList_New(0);
+    if (recs == NULL)
+        return NULL;
+    PyObject *newc = PyList_New(self->n_all);
+    if (newc == NULL) {
+        Py_DECREF(recs);
+        return NULL;
+    }
+    int64_t dropped = 0;
+    for (Py_ssize_t ri = 0; ri < self->n_all; ri++) {
+        TRing *r = self->all[ri];
+        int64_t cur = r->cursor;
+        int64_t prev = -1;
+        if (ri < ncur) {
+            prev = PyLong_AsLongLong(PyList_GET_ITEM(cursors, ri));
+            if (prev == -1 && PyErr_Occurred())
+                goto fail;
+        }
+        int64_t p = prev > r->base ? prev : r->base;
+        if (p > cur)
+            p = cur;
+        int64_t lo = p;
+        if (cur - r->cap > lo)
+            lo = cur - r->cap;
+        dropped += lo - p;
+        Py_ssize_t seg = 0;
+        while (seg + 1 < r->n_seg && r->seg_starts[seg + 1] <= lo)
+            seg++;
+        for (int64_t c = lo; c < cur; c++) {
+            while (seg + 1 < r->n_seg && r->seg_starts[seg + 1] <= c)
+                seg++;
+            Py_ssize_t slot = (Py_ssize_t)(c % r->phys);
+            PyObject **o = r->objs + slot * 3;
+            const int64_t *t = r->ts + slot * 2;
+            PyObject *tup = Py_BuildValue(
+                "LnLOOLOO", (long long)t[0], ri, (long long)c, o[0], o[1],
+                (long long)t[1], o[2], PyList_GET_ITEM(r->seg_tids, seg));
+            if (tup == NULL)
+                goto fail;
+            if (PyList_Append(recs, tup) < 0) {
+                Py_DECREF(tup);
+                goto fail;
+            }
+            Py_DECREF(tup);
+        }
+        PyObject *num = PyLong_FromLongLong(cur);
+        if (num == NULL)
+            goto fail;
+        PyList_SET_ITEM(newc, ri, num);
+    }
+    {
+        PyObject *nd = PyLong_FromLongLong(dropped);
+        if (nd == NULL)
+            goto fail;
+        PyObject *out = PyTuple_Pack(3, recs, newc, nd);
+        Py_DECREF(recs);
+        Py_DECREF(newc);
+        Py_DECREF(nd);
+        return out;
+    }
+fail:
+    Py_DECREF(recs);
+    Py_DECREF(newc);
+    return NULL;
+}
+
 static PyObject *TraceCore_dropped(TraceCoreObject *self, PyObject *noarg) {
     (void)noarg;
     int64_t lost = 0;
@@ -1062,6 +1225,8 @@ static PyMethodDef TraceCore_methods[] = {
     {"span", (PyCFunction)TraceCore_span, METH_FASTCALL,
      "span(name, cat, attrs) -> FastSpan"},
     {"drain", (PyCFunction)TraceCore_drain, METH_NOARGS, NULL},
+    {"drain_since", (PyCFunction)TraceCore_drain_since, METH_O,
+     "drain_since(cursors) -> (records, new_cursors, dropped)"},
     {"dropped", (PyCFunction)TraceCore_dropped, METH_NOARGS, NULL},
     {"live", (PyCFunction)TraceCore_live, METH_NOARGS, NULL},
     {"clear", (PyCFunction)TraceCore_clear, METH_NOARGS, NULL},

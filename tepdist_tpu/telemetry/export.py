@@ -120,6 +120,13 @@ def build_trace(payloads: Iterable[Dict[str, Any]],
         meta["flight_sampled_out"] = flight_sampled_out
     if extra_metadata:
         meta.update(extra_metadata)
+    # Active watchtower alerts ride every merged trace: a post-hoc dump
+    # of a run that ended with a live straggler/NaN/SLO-burn alert must
+    # say so (tools/trace_summary.py prints the alerts section).
+    from tepdist_tpu.telemetry import watchtower as _watchtower
+    alerts = _watchtower.active_alerts()
+    if alerts:
+        meta["alerts"] = alerts
     if meta:
         trace["metadata"] = meta
     return trace

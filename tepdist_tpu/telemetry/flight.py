@@ -52,7 +52,7 @@ import threading
 import time
 import weakref
 import zlib
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 _STRIDE = 4
 
@@ -183,6 +183,57 @@ class FlightRecorder:
         if clear:
             self.clear()
         return out
+
+    def delta(self, state: Optional[List[List[int]]] = None
+              ) -> Tuple[Dict[str, Any], List[List[int]]]:
+        """Cursor-based incremental read (ISSUE 17 watchtower stream).
+
+        ``state`` is the previous call's return: one ``[cursor,
+        sampled_out]`` pair per ring (ring indices are stable — the ring
+        list is append-only).  Returns ``(payload, new_state)`` where
+        payload matches ``snapshot()``'s event shape plus exact
+        ``dropped`` / ``sampled_out`` counts SINCE the caller's cursors.
+        Carrying the sampled-out cursor per ring is what keeps
+        ``TEPDIST_FLIGHT_SAMPLE``-shed requests from reading as phantom
+        gaps in watch state: a poll that saw no new events but a nonzero
+        sampled_out delta is complete, not lossy.  Nothing is consumed —
+        ``base``/``sampled_base`` stay put for full snapshots."""
+        state = list(state or [])
+        with self._reg_lock:
+            rings = list(self._rings)
+        anchor = self._anchor_ns
+        raw: List[Any] = []
+        dropped = 0
+        sampled_out = 0
+        new_state: List[List[int]] = []
+        for ridx, r in enumerate(rings):
+            cur = r.cursor
+            data = r.data[:]      # one C-level copy under the GIL
+            cur2 = r.cursor
+            so = r.sampled_out
+            if ridx < len(state):
+                prev, prev_so = int(state[ridx][0]), int(state[ridx][1])
+            else:
+                prev, prev_so = -1, r.sampled_base
+            p = min(max(prev, r.base), cur)
+            lo = max(p, cur - r.cap, cur2 - r.phys + 1)
+            dropped += lo - p
+            sampled_out += max(so - max(prev_so, r.sampled_base), 0)
+            phys = r.phys
+            for c in range(lo, cur):
+                i = (c % phys) * _STRIDE
+                raw.append((data[i + 2], ridx, c, data[i], data[i + 1],
+                            data[i + 3]))
+            new_state.append([cur, so])
+        raw.sort()
+        events = []
+        for ts_ns, _ridx, _c, rid, ev, args in raw:
+            entry = {"rid": rid, "ev": ev, "ts": (ts_ns + anchor) // 1000}
+            if args:
+                entry["args"] = dict(args)
+            events.append(entry)
+        return ({"events": events, "dropped": dropped,
+                 "sampled_out": sampled_out}, new_state)
 
     @property
     def dropped(self) -> int:

@@ -602,6 +602,67 @@ class RpcLedger:
             self.clear()
         return out
 
+    def delta(self, state: Optional[Dict[str, Any]] = None
+              ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Cursor-based incremental read (ISSUE 17 watchtower stream).
+
+        ``state`` is the (JSON-safe) cursor dict returned by the previous
+        call — ``{"core": [...], "py": [...]}``, one integer cursor per
+        ring.  Ring indices are stable identities: both ring lists are
+        append-only (dead threads' rings are parked for adoption, never
+        removed), so a cursor vector from poll N addresses the same rings
+        at poll N+1.  Returns ``(payload, new_state)`` where payload is::
+
+            {"records": [[kind, verb, step, t0_us, dur_us, a, b], ...],
+             "dropped": n}
+
+        with verb codes resolved to names and the monotonic record clock
+        mapped to epoch microseconds through the snapshot anchor (so the
+        records align with snapshots and cross-process NTP offsets).
+        Nothing is consumed — ``base`` is untouched and full snapshots
+        still see everything; ``dropped`` counts exactly the records
+        overwritten between the caller's cursor and the oldest readable
+        record (records below base were clear()ed, not dropped)."""
+        state = state or {}
+        with self._reg_lock:
+            rings = list(self._rings)
+            names = list(self._verb_names)
+        recs: List[Tuple[int, ...]] = []
+        dropped = 0
+        core_cursors = list(state.get("core") or [])
+        if self._core is not None:
+            crecs, core_cursors, cdrop = \
+                self._core.drain_since(core_cursors)
+            recs.extend(crecs)
+            dropped += cdrop
+            core_cursors = list(core_cursors)
+        py_cursors = list(state.get("py") or [])
+        new_py: List[int] = []
+        for ridx, r in enumerate(rings):
+            cur = r.cursor
+            data = r.data[:]      # one C-level memcpy under the GIL
+            cur2 = r.cursor
+            prev = py_cursors[ridx] if ridx < len(py_cursors) else -1
+            p = min(max(prev, r.base), cur)
+            # Same torn-slot guard as _drain(): racing records shed
+            # oldest-first and counted (they are about to be overwritten
+            # anyway, so the next poll's cursor never revisits them).
+            lo = max(p, cur - r.cap, cur2 - r.phys + 1)
+            dropped += lo - p
+            phys = r.phys
+            for c in range(lo, cur):
+                i = (c % phys) * _STRIDE
+                recs.append(tuple(data[i:i + _STRIDE]))
+            new_py.append(cur)
+        anchor = self._anchor_ns
+        out: List[List[int]] = []
+        for kind, code, step, t0, t1, a, b in recs:
+            verb = names[code] if code < len(names) else _UNATTRIBUTED
+            out.append([kind, verb, step, (t0 + anchor) // 1000,
+                        (t1 - t0) // 1000, a, b])
+        return ({"records": out, "dropped": dropped},
+                {"core": core_cursors, "py": new_py})
+
     @property
     def dropped(self) -> Dict[str, int]:
         """Per-category drop counts (kept as a property for parity with

@@ -39,7 +39,7 @@ import bisect
 import threading
 import time
 import weakref
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 try:  # native write path (telemetry/_fastobs.c); pure Python otherwise
     from tepdist_tpu.telemetry import _fastobs
@@ -256,6 +256,59 @@ class Tracer:
         if clear:
             self.clear()
         return out
+
+    def delta(self, state: Optional[Dict[str, Any]] = None
+              ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Cursor-based incremental read (ISSUE 17 watchtower stream):
+        ``state`` is ``{"core": [...], "py": [...]}`` per-ring cursor
+        vectors from the previous call (ring indices are stable — both
+        ring lists are append-only).  Returns ``(payload, new_state)``
+        with payload ``{"spans": [...export dicts...], "dropped": n}``;
+        nothing is consumed, so snapshots and the final trace dump still
+        see everything.  ``dropped`` counts exactly the spans overwritten
+        between the caller's cursors and the oldest readable span."""
+        state = state or {}
+        with self._reg_lock:
+            rings = list(self._rings)
+        anchor = self._anchor_ns
+        raw: List[Any] = []
+        dropped = 0
+        core_cursors = list(state.get("core") or [])
+        if self._core is not None:
+            crecs, core_cursors, cdrop = \
+                self._core.drain_since(core_cursors)
+            raw.extend(crecs)
+            dropped += cdrop
+            core_cursors = list(core_cursors)
+        py_cursors = list(state.get("py") or [])
+        new_py: List[int] = []
+        for pidx, r in enumerate(rings):
+            ridx = pidx + 1_000_000   # same source split as snapshot()
+            cur = r.cursor
+            data = r.data[:]
+            cur2 = r.cursor
+            prev = py_cursors[pidx] if pidx < len(py_cursors) else -1
+            p = min(max(prev, r.base), cur)
+            lo = max(p, cur - r.cap, cur2 - r.phys + 1)
+            dropped += lo - p
+            phys = r.phys
+            starts = r.seg_starts
+            tids = r.seg_tids
+            one_seg = tids[0] if len(tids) == 1 else None
+            for c in range(lo, cur):
+                i = (c % phys) * _STRIDE
+                tid = one_seg if one_seg is not None else \
+                    tids[bisect.bisect_right(starts, c) - 1]
+                raw.append((data[i + 2], ridx, c, data[i], data[i + 1],
+                            data[i + 3], data[i + 4], tid))
+            new_py.append(cur)
+        raw.sort()
+        spans = [{"name": name, "cat": cat,
+                  "ts": (t0 + anchor) // 1000, "dur": dur / 1e3,
+                  "tid": tid, "args": args}
+                 for t0, _ridx, _c, name, cat, dur, args, tid in raw]
+        return ({"spans": spans, "dropped": dropped},
+                {"core": core_cursors, "py": new_py})
 
     @property
     def dropped(self) -> int:

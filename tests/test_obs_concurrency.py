@@ -160,6 +160,116 @@ def test_ledger_snapshot_never_tears(mode):
             t.join()
 
 
+# -- cursor deltas (ISSUE 17: GetTelemetryDelta's read primitive) -----------
+
+def test_ledger_delta_exact_drops_across_polls(mode):
+    """Records overwritten BETWEEN two delta polls are reported exactly
+    in the drop counter — the watchtower's lag accounting contract."""
+    cap = 64
+    led = RpcLedger(enabled=True, ring_records=cap)
+    for s in range(10):
+        led.record_pack(1, s, 1000 + s, 1100 + s)
+    d1, state = led.delta()
+    assert len(d1["records"]) == 10
+    assert d1["dropped"] == 0
+    # Overflow the ring between polls: cap new survivors, the rest gone.
+    per = cap + 37
+    for s in range(per):
+        led.record_pack(2, s, 2000 + s, 2100 + s)
+    d2, state = led.delta(state)
+    assert len(d2["records"]) == cap
+    assert d2["dropped"] == per - cap
+    # Survivors are exactly the newest cap writes (b carries s).
+    assert sorted(r[6] for r in d2["records"]) == \
+        list(range(per - cap, per))
+    # Third poll with nothing new: empty, zero drops.
+    d3, state = led.delta(state)
+    assert d3["records"] == [] and d3["dropped"] == 0
+    # Non-consuming: the full snapshot still sees everything the ring
+    # holds, and its cumulative drop counter is its own accounting.
+    snap = led.snapshot()
+    assert snap["records_dropped"] == per + 10 - cap
+
+
+def test_ledger_delta_concurrent_writers(mode):
+    """Delta reads across N writer rings: a poll taken quiescent after
+    more writes captures exactly the new records, per ring."""
+    led = RpcLedger(enabled=True, ring_records=4096)
+    per = 200
+
+    def work(i: int) -> None:
+        for s in range(per):
+            led.record_pack(1, s, 1000 + s, 1100 + s)
+
+    _run_threads(work)
+    d1, state = led.delta()
+    assert len(d1["records"]) == N_THREADS * per
+    assert d1["dropped"] == 0
+    _run_threads(work)
+    d2, state = led.delta(state)
+    assert len(d2["records"]) == N_THREADS * per
+    assert d2["dropped"] == 0
+
+
+def test_trace_delta_exact_drops_across_polls(mode):
+    cap = 64
+    t = trace_mod.Tracer(capacity=cap, enabled=True)
+    _record_spans(t, 10)
+    d1, state = t.delta()
+    assert len(d1["spans"]) == 10 and d1["dropped"] == 0
+    per = cap + 21
+    _record_spans(t, per)
+    d2, state = t.delta(state)
+    assert len(d2["spans"]) == cap
+    assert d2["dropped"] == per - cap
+    d3, _ = t.delta(state)
+    assert d3["spans"] == [] and d3["dropped"] == 0
+    # Non-consuming: snapshot unaffected by the delta reads.
+    assert len(t.snapshot()) == cap
+
+
+def test_flight_delta_sampled_out_no_phantom_gaps(mode):
+    """TEPDIST_FLIGHT_SAMPLE shedding must surface as ``sampled_out``
+    in deltas, never as drops — a sampled-out request is a counted
+    policy decision, not telemetry loss, and the watchtower's lag
+    accounting must not see phantom gaps for it."""
+    rec = FlightRecorder(enabled=True, capacity=4096, sample=4)
+    rids = [f"req-{i}" for i in range(64)]
+    for rid in rids:
+        rec.record(rid, "submit")
+    d1, state = rec.delta()
+    assert d1["dropped"] == 0
+    assert len(d1["events"]) + d1["sampled_out"] == len(rids)
+    assert d1["sampled_out"] > 0
+    # Second window: the invariant holds per poll, not just cumulative.
+    for rid in rids:
+        rec.record(rid, "decode")
+    rec.record("*", "restart")        # wildcard bypasses sampling
+    d2, state = rec.delta(state)
+    assert d2["dropped"] == 0
+    assert len(d2["events"]) + d2["sampled_out"] == len(rids) + 1
+    assert any(e["rid"] == "*" for e in d2["events"])
+    # Deltas shed exactly what record() shed: same kept subset as the
+    # cumulative snapshot's.
+    snap_kept = {e["rid"] for e in rec.snapshot()["events"]}
+    assert {e["rid"] for e in d2["events"]} == snap_kept
+    d3, _ = rec.delta(state)
+    assert d3["events"] == [] and d3["sampled_out"] == 0
+
+
+def test_flight_delta_exact_drops_above_capacity(mode):
+    cap = 16
+    rec = FlightRecorder(enabled=True, capacity=cap)
+    d0, state = rec.delta()
+    per = 100
+    for s in range(per):
+        rec.record("r0", "decode", pos=s)
+    d1, state = rec.delta(state)
+    assert d1["dropped"] == per - cap
+    assert [e["args"]["pos"] for e in d1["events"]] == \
+        list(range(per - cap, per))
+
+
 # -- trace ------------------------------------------------------------------
 
 def _record_spans(tracer, n: int) -> None:

@@ -1,0 +1,353 @@
+"""Watchtower unit + end-to-end tests (ISSUE 17).
+
+Unit: robust scorer (leave-one-out MAD bands, 2-poll persistence,
+recovery, no-flap under symmetric jitter), training sentinel (NaN
+watchdog, halt mode, MAD-banded loss spike, no self-normalizing
+divergence), slo.toml subset parser, multi-window burn-rate engine
+(alert + recovery on a fake clock).
+
+End-to-end: a two-worker in-proc fleet with an injected ``rpc_delay``
+straggler raises the typed straggler alert through real
+GetTelemetryDelta polls within 2 polls of the digests filling, and an
+equal-length clean run raises nothing (the no-flap acceptance pair).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from tepdist_tpu.telemetry import watchtower as wt
+
+
+@pytest.fixture
+def board():
+    b = wt.AlertBoard()
+    yield b
+    b.clear()
+
+
+# -- robust statistics ------------------------------------------------------
+
+def test_median_and_mad_band():
+    assert wt.median([3.0, 1.0, 2.0]) == 2.0
+    assert wt.median([1.0, 2.0, 3.0, 4.0]) == 2.5
+    # All-equal sample: MAD is 0, the floor carries the band.
+    assert wt.mad_band([5.0] * 8, floor=2.0) == 2.0
+    assert wt.mad_band([], floor=1.5) == 1.5
+
+
+# -- training sentinel ------------------------------------------------------
+
+def test_sentinel_nan_watchdog_advisory(board):
+    s = wt.TrainingSentinel(board_=board)
+    a = s.observe(0, float("nan"))
+    assert a is not None and a.kind == wt.KIND_NAN
+    assert a.severity == "page"
+    assert any(x.kind == wt.KIND_NAN for x in board.active())
+
+
+def test_sentinel_nan_halt_mode_fences(board):
+    s = wt.TrainingSentinel(halt="nan", board_=board)
+    s.observe(0, 1.0)
+    with pytest.raises(wt.WatchHalt) as ei:
+        s.observe(1, float("inf"))
+    assert ei.value.alert.kind == wt.KIND_NAN
+    # The alert is on the board even though the halt propagated.
+    assert any(x.kind == wt.KIND_NAN for x in board.active())
+
+
+def test_sentinel_loss_spike_after_window_arms(board):
+    s = wt.TrainingSentinel(min_n=5, board_=board)
+    alerts = [s.observe(i, 1.0 + 0.01 * i) for i in range(8)]
+    assert all(a is None for a in alerts)
+    a = s.observe(8, 50.0)
+    assert a is not None and a.kind == wt.KIND_LOSS_SPIKE
+    assert a.value == 50.0 and a.threshold is not None
+
+
+def test_sentinel_divergence_does_not_self_normalize(board):
+    """A ratcheting loss must KEEP alerting: spikes are excluded from
+    the baseline window, so divergence can't normalize itself away."""
+    s = wt.TrainingSentinel(min_n=5, board_=board)
+    for i in range(6):
+        s.observe(i, 1.0)
+    hits = sum(1 for i in range(6, 16)
+               if s.observe(i, 10.0 + i) is not None)
+    assert hits == 10
+
+
+def test_sentinel_noisy_but_healthy_loss_stays_quiet(board):
+    rng = np.random.RandomState(7)
+    s = wt.TrainingSentinel(board_=board)
+    for i in range(200):
+        loss = 2.0 * math.exp(-i / 80.0) + float(rng.uniform(0, 0.08))
+        assert s.observe(i, loss) is None
+    assert board.active() == []
+
+
+# -- straggler scorer -------------------------------------------------------
+
+def _feed(sc, worker, signal, vals):
+    for v in vals:
+        sc.add(worker, signal, v)
+
+
+def test_scorer_two_worker_straggler_two_poll_persistence(board):
+    sc = wt.StragglerScorer(board_=board, persist_polls=2)
+    _feed(sc, 0, "rtt_ms", [2.0] * 6)
+    _feed(sc, 1, "rtt_ms", [60.0] * 6)
+    # Poll 1: outlier streak starts, NO alert yet (one slow poll is a
+    # GC pause, not a straggler).
+    assert not any(a.kind == wt.KIND_STRAGGLER for a in sc.evaluate())
+    # Poll 2: persistent — alert fires, attributed to worker 1.
+    alerts = sc.evaluate()
+    stragglers = [a for a in alerts if a.kind == wt.KIND_STRAGGLER]
+    assert len(stragglers) == 1 and stragglers[0].worker == 1
+
+
+def test_scorer_recovery_resolves_alert(board):
+    sc = wt.StragglerScorer(board_=board, persist_polls=2, depth=8)
+    _feed(sc, 0, "rtt_ms", [2.0] * 8)
+    _feed(sc, 1, "rtt_ms", [60.0] * 8)
+    sc.evaluate()
+    sc.evaluate()
+    assert any(a.kind == wt.KIND_STRAGGLER for a in board.active())
+    _feed(sc, 1, "rtt_ms", [2.0] * 8)     # digest depth 8: fully flushed
+    sc.evaluate()
+    assert not any(a.kind == wt.KIND_STRAGGLER for a in board.active())
+
+
+def test_scorer_no_flap_on_symmetric_jitter(board):
+    rng = np.random.RandomState(1)
+    sc = wt.StragglerScorer(board_=board)
+    for _ in range(30):
+        sc.add(0, "rtt_ms", 2.0 + float(rng.random_sample()))
+        sc.add(1, "rtt_ms", 2.0 + float(rng.random_sample()))
+    for _ in range(10):
+        assert not any(a.kind == wt.KIND_STRAGGLER
+                       for a in sc.evaluate())
+
+
+def test_scorer_fleet_shape_change_event(board):
+    sc = wt.StragglerScorer(board_=board)
+    _feed(sc, 0, "rtt_ms", [2.0] * 3)
+    _feed(sc, 1, "rtt_ms", [2.0] * 3)
+    sc.evaluate()
+    _feed(sc, 2, "rtt_ms", [2.0] * 3)      # worker 2 appears
+    alerts = sc.evaluate()
+    shapes = [a for a in alerts if a.kind == wt.KIND_FLEET_SHAPE]
+    assert shapes and "+[2]" in shapes[0].detail
+
+
+# -- slo.toml parser --------------------------------------------------------
+
+SLO_TOML = """
+# step-time objective
+[slo.step_p95]
+metric = "step_time_ms"
+stat = "p95"
+target = 50.0
+budget = 0.05
+windows_s = [5.0, 20.0]
+burn_threshold = 2.0
+min_samples = 2
+
+[slo.serve_ttft]
+metric = "serve_ttft_ms"
+class = "interactive"
+target = 100.0
+
+[slo.errors]
+metric = "error_rate"
+target = 0.01
+bad_counters = ["serve_requests_rejected", "serve_requests_failed"]
+total_counters = ["serve_requests_submitted"]
+
+[other.table]          # foreign tables are ignored
+key = 1
+"""
+
+
+def test_parse_slo_toml_subset(tmp_path):
+    p = tmp_path / "slo.toml"
+    p.write_text(SLO_TOML)
+    targets = {t.name: t for t in wt.load_slo_targets(str(p))}
+    assert set(targets) == {"step_p95", "serve_ttft", "errors"}
+    t = targets["step_p95"]
+    assert (t.metric, t.stat, t.target) == ("step_time_ms", "p95", 50.0)
+    assert t.windows_s == (5.0, 20.0) and t.budget == 0.05
+    assert targets["serve_ttft"].metric_key == "serve_ttft_ms:interactive"
+    assert targets["errors"].bad_counters == (
+        "serve_requests_rejected", "serve_requests_failed")
+
+
+def test_parse_slo_toml_tolerates_junk():
+    tables = wt.parse_slo_toml(
+        "[slo.x]\nmetric = \"m\"\ntarget = 1.0\nbroken line\n"
+        "bad = not_a_value\n")
+    assert tables["x"]["metric"] == "m" and "bad" not in tables["x"]
+
+
+# -- burn-rate engine -------------------------------------------------------
+
+def _engine(board, **kw):
+    t = wt.SloTarget(name="step", metric="step_time_ms", target=50.0,
+                     budget=0.10, windows_s=(5.0, 20.0),
+                     burn_threshold=2.0, min_samples=2, **kw)
+    clock = [0.0]
+    eng = wt.SLOEngine([t], board_=board, clock=lambda: clock[0])
+    return eng, clock
+
+
+def test_burn_rate_alerts_on_sustained_breach_and_recovers(board):
+    eng, clock = _engine(board)
+    for _ in range(30):
+        clock[0] += 1.0
+        eng.feed("step_time_ms", [200.0])
+        eng.observe({})
+    alerts = eng.evaluate()
+    assert any(a.kind == wt.KIND_SLO_BURN and a.name == "step"
+               for a in alerts)
+    for _ in range(40):
+        clock[0] += 1.0
+        eng.feed("step_time_ms", [5.0])
+        eng.observe({})
+    eng.evaluate()
+    assert not any(a.kind == wt.KIND_SLO_BURN for a in board.active())
+
+
+def test_burn_rate_short_transient_does_not_alert(board):
+    """Multi-window AND: a short breach trips the 5 s window but not
+    the 20 s window, so no alert — the flap-suppression property."""
+    eng, clock = _engine(board)
+    for i in range(25):
+        clock[0] += 1.0
+        eng.feed("step_time_ms", [200.0 if i >= 22 else 5.0])
+        eng.observe({})
+    assert eng.evaluate() == []
+
+
+def test_burn_rate_error_rate_counters(board):
+    t = wt.SloTarget(name="err", metric="error_rate", target=0.01,
+                     budget=0.5, windows_s=(5.0,), burn_threshold=1.0,
+                     min_samples=2,
+                     bad_counters=("bad",), total_counters=("total",))
+    clock = [0.0]
+    eng = wt.SLOEngine([t], board_=board, clock=lambda: clock[0])
+    bad, total = 0, 0
+    for _ in range(6):
+        clock[0] += 1.0
+        bad += 5
+        total += 50                    # 10% error rate per interval
+        eng.observe({"counters": {"bad": bad, "total": total}})
+    alerts = eng.evaluate()
+    assert any(a.kind == wt.KIND_SLO_BURN and a.name == "err"
+               for a in alerts)
+
+
+# -- alert board ------------------------------------------------------------
+
+def test_board_dedups_by_key_and_counts(board):
+    a1 = wt.HealthAlert(kind="straggler", worker=1, detail="first")
+    a2 = wt.HealthAlert(kind="straggler", worker=1, detail="second")
+    board.publish(a1)
+    cur = board.publish(a2)
+    assert cur.count == 2 and cur.detail == "second"
+    assert len(board.active()) == 1
+    board.resolve("straggler:1")
+    assert board.active() == []
+
+
+# -- end-to-end: injected straggler through real delta polls ----------------
+
+@pytest.fixture
+def inproc_fleet():
+    import jax
+    import optax
+
+    from tepdist_tpu.parallel.pipeline import plan_pipeline
+    from tepdist_tpu.rpc.inproc import (close_inproc_cluster,
+                                        make_inproc_cluster)
+    from tepdist_tpu.runtime.distributed_executor import (
+        DistributedPipelineSession,
+    )
+    from tools.ledger_report import _model
+
+    loss_fn, params, x, y = _model()
+    prog = plan_pipeline(loss_fn, 2, 2, params, x, y)
+    cluster, _ = make_inproc_cluster(2, jax.devices()[:1])
+    sess = DistributedPipelineSession(prog, cluster,
+                                      optimizer=optax.sgd(1e-2))
+    sess.load_variables(params)
+    try:
+        yield sess
+    finally:
+        sess.close()
+        close_inproc_cluster(cluster)
+
+
+@pytest.mark.slow
+def test_straggler_alert_within_two_polls_and_no_flap(inproc_fleet):
+    from tepdist_tpu.runtime import faults
+    from tepdist_tpu.telemetry import watchtower
+
+    sess = inproc_fleet
+    # Clean baseline: digests fill, no alert may fire (no-flap).
+    clean = watchtower.Watchtower(
+        clients=[sess.clients[ti] for ti in sorted(sess.clients)],
+        board_=wt.AlertBoard())
+    for _ in range(6):
+        sess.step(*_batch(sess))
+        clean.poll_once()
+    assert not any(a.kind == wt.KIND_STRAGGLER
+                   for a in clean.scorer._board.active())
+
+    # Injected straggler: delay every RPC to worker 1 by 60 ms. The
+    # watchtower measures its own delta-poll RTTs, so the alert comes
+    # from genuinely slow RPCs, within persist_polls(=2) of the digests
+    # separating.
+    board = wt.AlertBoard()
+    hot = watchtower.Watchtower(
+        clients=[sess.clients[ti] for ti in sorted(sess.clients)],
+        board_=board)
+    faults.configure("rpc_delay:ms=60,ti=1")
+    try:
+        fired_at = None
+        for poll in range(6):
+            sess.step(*_batch(sess))
+            hot.poll_once()
+            if any(a.kind == wt.KIND_STRAGGLER and a.worker == 1
+                   for a in board.active()):
+                fired_at = poll + 1
+                break
+        assert fired_at is not None, "straggler alert never fired"
+        assert fired_at <= 2, f"took {fired_at} polls (contract: <= 2)"
+    finally:
+        faults.reset()
+
+
+def _batch(sess):
+    from tools.ledger_report import _model
+    _, _, x, y = _model()
+    return x, y
+
+
+def test_delta_rpc_roundtrip_carries_alerts(inproc_fleet):
+    """Alerts published to the process board ride GetTelemetryDelta —
+    the path an external watch.py --connect observer reads."""
+    from tepdist_tpu.telemetry import watchtower
+
+    sess = inproc_fleet
+    client = sess.clients[sorted(sess.clients)[0]]
+    r1 = client.get_telemetry_delta()
+    assert r1["ok"] and "cursors" in r1
+    watchtower.board().publish(
+        wt.HealthAlert(kind="nan", detail="test alert", severity="page"))
+    try:
+        r2 = client.get_telemetry_delta(cursors=r1["cursors"])
+        assert any(a["kind"] == "nan" for a in r2["alerts"])
+    finally:
+        watchtower.board().resolve("nan")

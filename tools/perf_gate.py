@@ -60,7 +60,10 @@ DEFAULT_KEYS = ("two_worker_fleet_ms", "two_worker_fleet_compressed_ms",
                 # the instruments themselves, self-gated like any other
                 # perf line (tools/obs_overhead.py records them).
                 "ledger_overhead_pct", "trace_enabled_ns_per_span",
-                "flight_overhead_pct")
+                "flight_overhead_pct",
+                # ISSUE 17: cost of the watchtower itself (sentinel
+                # observe + delta polling) on the fleet step.
+                "watch_overhead_pct")
 
 # Per-key relative noise-band floors overriding the global --band-pct
 # when larger.  The overhead percentages are ratios of two noisy
@@ -69,7 +72,8 @@ DEFAULT_KEYS = ("two_worker_fleet_ms", "two_worker_fleet_compressed_ms",
 # a 10% floor would flap.  15% still trips the smoke's seeded 20%
 # regression, and the absolute <=2% budget is enforced independently
 # by ``obs_overhead --check``; this band only needs to catch drift.
-BAND_FLOOR_PCT = {"ledger_overhead_pct": 0.15, "flight_overhead_pct": 0.15}
+BAND_FLOOR_PCT = {"ledger_overhead_pct": 0.15, "flight_overhead_pct": 0.15,
+                  "watch_overhead_pct": 0.15}
 
 _HIGHER_BETTER_SUFFIXES = ("tok_s", "_x", "_per_s", "_rate", "_speedup")
 _PROMOTE_SUFFIXES = ("_ms", "_us", "_x", "_pct", "tok_s", "_per_s",
@@ -171,14 +175,25 @@ def check_values(values: Dict[str, float],
                  k: int = 5, band_pct: float = 0.10
                  ) -> List[Dict[str, Any]]:
     """Per-key verdicts: ok / regression / improved / no-baseline /
-    missing. Only 'regression' fails the gate."""
+    missing / missing_key. 'regression' and 'missing_key' fail the
+    gate: a gated key with history that the latest record no longer
+    carries means its bench stopped reporting — silently passing that
+    is exactly how a perf line dies unnoticed. A key with NO history
+    either stays 'missing' (never benched here; common on fresh
+    checkouts and narrowed --keys runs)."""
     rows: List[Dict[str, Any]] = []
     for key in keys:
         cur = values.get(key)
         row: Dict[str, Any] = {"key": key, "current": cur,
                                "higher_better": higher_is_better(key)}
         if cur is None:
-            row["verdict"] = "missing"
+            base = baseline(history, key, k=k)
+            if base is not None:
+                row["verdict"] = "missing_key"
+                row.update(baseline_median=round(base["median"], 3),
+                           n_baseline=base["n"])
+            else:
+                row["verdict"] = "missing"
             rows.append(row)
             continue
         base = baseline(history, key, k=k)
@@ -296,7 +311,8 @@ def main(argv=None) -> int:
     keys = tuple(k for k in args.keys.split(",") if k)
     rows = check_values(values, prior, keys=keys, k=args.k,
                         band_pct=args.band_pct)
-    bad = [r for r in rows if r["verdict"] == "regression"]
+    bad = [r for r in rows
+           if r["verdict"] in ("regression", "missing_key")]
 
     # --plan-diff: an exploration winner flip is only acceptable when
     # it bought a measurable bench improvement — otherwise the plan
@@ -332,8 +348,8 @@ def main(argv=None) -> int:
     else:
         for r in rows:
             cur = "-" if r["current"] is None else f"{r['current']:.3f}"
-            base = (f"median {r['baseline_median']} +/- {r['band']} "
-                    f"(n={r['n_baseline']})"
+            base = (f"median {r['baseline_median']} +/- "
+                    f"{r.get('band', '?')} (n={r['n_baseline']})"
                     if "baseline_median" in r else "no baseline")
             arrow = "^" if r["higher_better"] else "v"
             print(f"  {r['key']:<28} {cur:>12} vs {base:<34} "
@@ -347,7 +363,10 @@ def main(argv=None) -> int:
                   f"{plan_flip['new_winner']} "
                   f"(driver: {plan_flip['driver']}): {verdict}")
         print("perf gate: " + ("FAILED on " +
-                               ", ".join(r["key"] for r in bad)
+                               ", ".join(
+                                   (f"missing_key:{r['key']}"
+                                    if r["verdict"] == "missing_key"
+                                    else r["key"]) for r in bad)
                                if bad else "OK"))
     return 1 if bad else 0
 

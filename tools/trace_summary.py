@@ -109,6 +109,11 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
                 "flight_sampled_out"):
         if meta.get(key):
             out[key] = meta[key]
+    # Watchtower alerts active when the trace was dumped
+    # (telemetry/watchtower.py): a run that ended with a live
+    # straggler/NaN/SLO-burn alert must say so in its post-hoc summary.
+    if meta.get("alerts"):
+        out["alerts"] = meta["alerts"]
     fid = _fidelity_section(trace)
     if fid is not None:
         out["fidelity"] = fid
@@ -273,6 +278,12 @@ def main() -> None:
         print(json.dumps(s, indent=1))
         return
     print(f"{s['n_events']} spans")
+    for a in s.get("alerts") or ():
+        who = (f" worker={a['worker']}" if a.get("worker") is not None
+               else "")
+        print(f"ALERT [{a.get('severity', 'warn')}] "
+              f"{a.get('key', a.get('kind'))}:{who} {a.get('detail')} "
+              f"(x{a.get('count', 1)})")
     if s.get("spans_dropped"):
         drops = ", ".join(f"{k}={v}"
                           for k, v in sorted(s["spans_dropped"].items()))
@@ -315,6 +326,19 @@ def main() -> None:
         print("fault recovery:")
         for k, v in sorted(fault.items()):
             print(f"  {k:<28} {v}")
+    # Heartbeat RTT percentiles, pooled and per worker: the monitor has
+    # fed these histograms since the health PR, but only the last-sample
+    # gauge was ever printed — the tail (the straggler signal) was
+    # invisible post-hoc.
+    all_hists = (s.get("metrics") or {}).get("histograms") or {}
+    hb = {k: h for k, h in all_hists.items()
+          if k == "heartbeat_rtt_ms" or k.startswith("heartbeat_rtt_ms:")}
+    if hb:
+        print("health (heartbeat rtt, ms):")
+        for k, h in sorted(hb.items()):
+            label = ("fleet" if k == "heartbeat_rtt_ms"
+                     else f"worker {k.split(':', 1)[1]}")
+            print(f"  {label:<28} {_pctl(h)} n={h['count']}")
     # Serving recovery/overload counters don't share the serve_ prefix
     # (engine_restarts etc. name the mechanism, not the plane).
     SERVING_EXTRA = ("engine_restarts", "requests_replayed",
