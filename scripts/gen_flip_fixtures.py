@@ -5,11 +5,20 @@ Runs the full exploration over the GPT-2 ``test`` config graph under a
 seeded environment perturbation and writes the observatory
 ExplorationReports to ``tests/fixtures/``:
 
-    coll_flip_before.json   ICI 400 GB/s  -> fidelity winner
-    coll_flip_after.json    ICI 5 MB/s    -> @int8 winner, driver coll_s
-    zero_flip_before.json   healthy HBM   -> fidelity winner
-    zero_flip_after.json    HBM 2.4 MB    -> @zero winner, driver
-                                             memory_feasible
+    coll_flip_before.json      ICI 400 GB/s  -> fidelity winner
+    coll_flip_after.json       ICI 5 MB/s    -> @int8 winner, driver coll_s
+    zero_flip_before.json      healthy HBM   -> fidelity winner
+    zero_flip_after.json       HBM 2.4 MB    -> @zero winner, driver
+                                               memory_feasible
+    flip_fleet_shrink_old.json 8 devices     -> 8-way mesh winner
+    flip_fleet_shrink_new.json replan @ 4    -> winner evicted, driver
+                                               candidate_set_change
+
+The fleet-shrink pair is NOT two explorations: the new report is
+``replan_for_fleet(old, 4)`` — the elastic-migration replanner filtering
+the recorded 8-device candidate table down to configs that fit the
+surviving 4-device fleet. The 8-way winner mesh cannot, so the diff
+names ``candidate_set_change``.
 
 The comm-dtype pair starves interconnect bandwidth until the compressed
 wire pays for itself. The ZeRO pair starves HBM until the fidelity
@@ -48,7 +57,7 @@ OUT = os.path.join(os.path.dirname(__file__), "..", "tests", "fixtures")
 ZERO_FLIP_HBM_GB = 0.0024
 
 
-def report(env: dict):
+def report(env: dict, include_pipeline: bool = False):
     try:
         ServiceEnv.reset(env)
         cfg = gpt2.CONFIGS["test"]
@@ -60,7 +69,8 @@ def report(env: dict):
             return gpt2.loss_fn(p, t, cfg)
 
         best = explore(loss, params, toks, n_devices=8,
-                       num_micro_batches=2, include_pipeline=False,
+                       num_micro_batches=2,
+                       include_pipeline=include_pipeline,
                        include_seq=False)
         print(f"{env}: winner kind={best.get('kind')} "
               f"config={best.get('config')!r} "
@@ -84,6 +94,24 @@ def main():
     )
     for name, env in pairs:
         rep = report(env)
+        path = os.path.join(OUT, name)
+        with open(path, "w") as f:
+            json.dump(rep, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {path} ({os.path.getsize(path)} bytes)")
+
+    # Fleet-shrink pair: one healthy 8-device exploration, then the
+    # elastic replanner projects it onto the 4-device survivor fleet.
+    # Pipeline candidates MUST be enumerated: every 8-device spmd mesh
+    # uses all 8 devices, so only the S|4 pipeline rows survive the
+    # shrink and the new winner comes from them.
+    from tepdist_tpu.parallel.exploration import replan_for_fleet
+
+    old = report({"ICI_BANDWIDTH": 400.0}, include_pipeline=True)
+    new, diff = replan_for_fleet(old, 4)
+    assert diff["flip"] and diff["driver"] == "candidate_set_change", diff
+    for name, rep in (("flip_fleet_shrink_old.json", old),
+                      ("flip_fleet_shrink_new.json", new)):
         path = os.path.join(OUT, name)
         with open(path, "w") as f:
             json.dump(rep, f, indent=1, sort_keys=True)

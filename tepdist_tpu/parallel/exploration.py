@@ -648,6 +648,85 @@ def candidate_summary(candidates, best=None) -> List[Dict[str, Any]]:
     return rows
 
 
+# ----------------------------------------------------------------------
+# Fleet replan (ISSUE 18 live migration): re-rank a RECORDED report for a
+# new fleet shape
+# ----------------------------------------------------------------------
+
+def _config_fits_devices(row: Dict[str, Any], n_devices: int) -> bool:
+    """Whether a recorded candidate row's config is placeable on
+    ``n_devices`` — the same feasibility rules the enumerators apply at
+    proposal time (mesh axis product; S|interleave-group divisibility),
+    re-checked from the config STRING because a persisted report no
+    longer carries the live proposal dicts."""
+    import re as _re
+    cfg = row["config"].split("@", 1)[0].strip()
+    if row["kind"] == "spmd":
+        prod = 1
+        for _, v in _re.findall(r"(\w+)=(\d+)", cfg):
+            prod *= int(v)
+        return 0 < prod <= n_devices
+    m = _re.search(r"\bS=(\d+)", cfg)
+    if not m:
+        return False
+    S = int(m.group(1))
+    g = _re.search(r"il/G=(\d+)", cfg)
+    if g:
+        G = int(g.group(1))
+        return 0 < G <= n_devices and n_devices % G == 0
+    if S <= n_devices and n_devices % S == 0:
+        return True
+    # Blocked fallback the pipeline enumerator allows: two virtual
+    # stages per device group.
+    return S % 2 == 0 and S // 2 <= n_devices and n_devices % (S // 2) == 0
+
+
+def replan_for_fleet(report: Dict[str, Any], n_devices: int,
+                     n_workers: int = None
+                     ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Re-run the ranking of a recorded exploration report against a NEW
+    fleet shape (live migration's replan step): drop candidates whose
+    config no longer fits ``n_devices``, re-rank the survivors by the
+    same (memory_feasible, total_s) argmin key, and name WHY the winner
+    moved via :func:`observatory.diff_reports` (a shrink that evicts the
+    old winner reports ``driver == "candidate_set_change"``).
+
+    Recorded costs were modeled for the OLD shape — this is a cheap
+    re-rank of the recorded frontier, not a fresh enumeration; the
+    migration path only needs the driver attribution and a feasible
+    winner, and a full re-exploration can follow out-of-band.
+
+    Returns ``(new_report_dict, diff)``; raises ``ValueError`` when no
+    recorded candidate fits the new shape."""
+    old_cands = report.get("candidates") or []
+    kept = [dict(c) for c in old_cands
+            if _config_fits_devices(c, n_devices)]
+    if not kept:
+        raise ValueError(
+            f"no recorded candidate fits {n_devices} devices "
+            f"(report had {len(old_cands)})")
+    kept.sort(key=lambda c: (not c["cost"]["memory_feasible"],
+                             c["cost"]["total_s"]))
+    for rank, c in enumerate(kept):
+        c["rank"] = rank
+        c["winner"] = rank == 0
+    new_report = dict(report)
+    new_report["candidates"] = kept
+    new_report["winner"] = kept[0]
+    new_report["runner_up"] = next(
+        (c for c in kept[1:] if c["cost"]["memory_feasible"]), None)
+    new_report["n_devices"] = n_devices
+    new_report["replanned_from_devices"] = report.get("n_devices")
+    diff = observatory.diff_reports(report, new_report)
+    log.warning(
+        "fleet replan: %d devices%s -> %d candidates of %d kept, "
+        "winner %s (driver %s)", n_devices,
+        f" / {n_workers} workers" if n_workers else "",
+        len(kept), len(old_cands), kept[0]["config"],
+        diff.get("driver") or "none (winner unchanged)")
+    return new_report, diff
+
+
 def _dump_candidate_table(candidates, best) -> None:
     from tepdist_tpu.core.debug_dump import write_dump
 

@@ -23,6 +23,57 @@ import numpy as np
 Bounds = Tuple[Tuple[int, int], ...]
 
 
+class RedistributionError(ValueError):
+    """A destination shard cannot be filled from the source layout.
+
+    ``kind`` names the failure (currently ``"coverage"``); ``intervals``
+    is the counterexample — the uncovered destination sub-rectangles, each
+    a ``Bounds`` in global coordinates. Mirrors the
+    ``PlanVerificationError`` convention: typed, machine-readable, and
+    carrying the minimal witness a caller (or a fallback path such as the
+    live-migration checkpoint rung) needs to act on.
+    """
+
+    def __init__(self, kind: str, intervals: List[Bounds], message: str):
+        super().__init__(message)
+        self.kind = kind
+        self.intervals = intervals
+
+
+def _subtract(region: Bounds, hole: Bounds) -> List[Bounds]:
+    """Rectangle subtraction: ``region`` minus ``hole`` as disjoint
+    boxes. ``hole`` must already be clipped to ``region`` (as overlap()
+    outputs are); empty result means the hole covers the region."""
+    out: List[Bounds] = []
+    rest = list(region)
+    for dim, ((r0, r1), (h0, h1)) in enumerate(zip(region, hole)):
+        if h0 > r0:
+            out.append(tuple(rest[:dim]) + ((r0, h0),) + region[dim + 1:])
+        if h1 < r1:
+            out.append(tuple(rest[:dim]) + ((h1, r1),) + region[dim + 1:])
+        rest[dim] = (h0, h1)
+    return out
+
+
+def uncovered_intervals(
+    dst: Bounds, pieces: Sequence[Bounds]
+) -> List[Bounds]:
+    """The parts of ``dst`` not covered by any piece, as disjoint boxes."""
+    holes: List[Bounds] = [dst]
+    for p in pieces:
+        nxt: List[Bounds] = []
+        for h in holes:
+            inter = overlap(h, p)
+            if inter is None:
+                nxt.append(h)
+            else:
+                nxt.extend(_subtract(h, inter))
+        holes = nxt
+        if not holes:
+            break
+    return holes
+
+
 def _size(b: Bounds) -> int:
     n = 1
     for a, z in b:
@@ -61,10 +112,12 @@ def plan_redistribution(
             pieces.append((i, inter))
             covered += _size(inter)
         if covered != _size(d):
-            raise ValueError(
+            missing = uncovered_intervals(d, [b for _i, b in pieces])
+            raise RedistributionError(
+                "coverage", missing,
                 f"redistribution coverage incomplete for dst {d}: "
                 f"{covered}/{_size(d)} elements from {len(src)} source "
-                "shards")
+                f"shards; uncovered intervals: {missing}")
         plan.append(pieces)
     return plan
 

@@ -45,7 +45,12 @@ IDEMPOTENT_TOKEN_VERBS = {"ExecutePlan", "DispatchPlan",
                           # A replayed Drain must answer with the ORIGINAL
                           # handoff list — re-draining an already-drained
                           # engine would return [] and lose the handoffs.
-                          "Drain"}
+                          "Drain",
+                          # Live migration: a replayed AdoptShard must
+                          # answer from the cache, never re-pull and
+                          # re-install (FetchShard is a pure read and
+                          # carries no token).
+                          "AdoptShard"}
 
 
 class GRPCStub:
@@ -157,8 +162,9 @@ class TepdistClient:
                                   max_attempts=max_attempts)
 
     # -- lifecycle ----------------------------------------------------
-    def ping(self) -> Dict[str, Any]:
-        header, _ = protocol.unpack(self.call("Ping", {}))
+    def ping(self, want_ckpt_steps: bool = False) -> Dict[str, Any]:
+        hdr = {"want_ckpt_steps": True} if want_ckpt_steps else {}
+        header, _ = protocol.unpack(self.call("Ping", hdr))
         return header
 
     def wait_ready(self, timeout: float = 30.0) -> None:
@@ -397,6 +403,39 @@ class TepdistClient:
             timeout=retry.deadline_for("Drain") + wait_ms / 1e3)
         header, _ = protocol.unpack(resp)
         return header["handed_off"]
+
+    # -- live migration ------------------------------------------------
+    def fetch_shard(self, global_idx: Optional[int] = None, *,
+                    bounds: Optional[Sequence[Sequence[int]]] = None,
+                    opt_stage: Optional[int] = None,
+                    wire_dtype: Optional[str] = None
+                    ) -> Optional[Any]:
+        """Pure read of migration source state. Variable mode
+        (``global_idx``, optional ``bounds`` slice in global coordinates)
+        returns one ndarray; ``opt_stage`` mode returns the stage's
+        optimizer slot list. None when the worker does not hold the key."""
+        resp = self.call("FetchShard", {
+            "global_idx": global_idx,
+            "bounds": [list(b) for b in bounds] if bounds else None,
+            "opt_stage": opt_stage, "wire_dtype": wire_dtype})
+        header, blobs = protocol.unpack(resp)
+        if not header.get("found"):
+            return None
+        if opt_stage is not None:
+            return [protocol.decode_literal(m, blobs[i])
+                    for i, m in enumerate(header["slots"])]
+        return protocol.decode_literal(header["literal"], blobs[0])
+
+    def adopt_shard(self, moves: List[Dict[str, Any]],
+                    migration_id: str = "") -> Dict[str, Any]:
+        """Instruct the destination worker to pull + install the listed
+        shard moves (see server.AdoptShard for the move schema). Mutating
+        — rides the idem token so a replay is answered from the dedup
+        cache. Returns {"adopted": n, "dedup": bool}."""
+        resp = self.call("AdoptShard",
+                         {"moves": moves, "migration_id": migration_id})
+        header, _ = protocol.unpack(resp)
+        return header
 
     # -- checkpoint ----------------------------------------------------
     def do_remote_save(self, max_to_keep: int = 5,

@@ -40,6 +40,23 @@ RPC surface (method -> reference RPC):
                            final trace dump still see everything. The
                            watchtower poller lives on this verb —
                            telemetry/watchtower.py)
+  FetchShard            -> (no reference analogue: live-migration pure
+                           read — returns the requested slice of a held
+                           variable, or a stage's optimizer slots, as
+                           Frames blobs encoded at the caller's
+                           ``wire_dtype``. Naturally idempotent; safe to
+                           deadline-retry. ``{"found": false}`` when the
+                           worker does not hold the key)
+  AdoptShard            -> (no reference analogue: live-migration write —
+                           the destination worker pulls shard pieces from
+                           live peers via nested FetchShard (or from the
+                           shared checkpoint dir when no live clean source
+                           remains), assembles them (plan_redistribution),
+                           and installs variables/opt-state locally.
+                           Mutating: carries an idem token, deduped by the
+                           server response cache, and classified
+                           NO_DEADLINE_RETRY — a retried AdoptShard can
+                           never double-apply)
   LoadServable          -> (no reference analogue: ships a model config +
                            params and starts a continuous-batching serving
                            engine — tepdist_tpu/serving/)
@@ -98,6 +115,8 @@ METHODS = [
     "PollResult",
     "CancelRequest",
     "Drain",
+    "FetchShard",
+    "AdoptShard",
 ]
 
 # Reference keeps INT_MAX message sizes (client_library.cc:152-156).

@@ -71,6 +71,11 @@ DEADLINES = {
     # Drain's budget is on top of the client-requested slot-finish wait
     # (rpc/client.py adds wait_ms to the timeout, like PollResult).
     "Drain": 60.0,
+    # Live migration (ISSUE 18): FetchShard is a pure read sized like a
+    # variable transfer; AdoptShard pulls + assembles + installs a whole
+    # destination shard set (nested FetchShards or checkpoint reads).
+    "FetchShard": 120.0,
+    "AdoptShard": 300.0,
 }
 DEFAULT_DEADLINE = 300.0
 
@@ -79,7 +84,13 @@ DEFAULT_DEADLINE = 300.0
 # server-side idempotency cache absorbs an applied-but-unacknowledged
 # replay.
 NO_DEADLINE_RETRY = {"ExecutePlan", "ExecuteRemotePlan",
-                     "ExecuteStepSlice", "Ping"}
+                     "ExecuteStepSlice", "Ping",
+                     # AdoptShard fans out nested FetchShards and may
+                     # still be assembling when the deadline fires; a
+                     # blind replay would race the original (the idem
+                     # cache only absorbs COMPLETED originals). FetchShard
+                     # stays deadline-retryable: it is a pure read.
+                     "AdoptShard"}
 
 
 def deadline_for(method: str, override: Optional[float] = None) -> float:

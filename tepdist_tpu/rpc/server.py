@@ -219,6 +219,11 @@ class TepdistServicer:
         # Serving engines (tepdist_tpu/serving/): servable_id -> engine.
         self.servables: Dict[str, Any] = {}
         self._servable_next = 1
+        # Live migration staging (ISSUE 18): optimizer slots adopted
+        # BEFORE the migration's DispatchPlan lands (the old plan — or no
+        # plan at all, for a joining worker — is still installed when
+        # AdoptShard runs). DispatchPlan's carry_state merge consumes it.
+        self.adopted_opt: Dict[int, List[Any]] = {}
 
     # -- idempotency dedup (see _idem_cache in __init__) ----------------
     _IDEM_CACHE_MAX = 128
@@ -1072,6 +1077,24 @@ class TepdistServicer:
         self._inject_server_fault("DispatchPlan")
         tasks = header.get("tasks", [])
         self._dispatched_tasks = tasks
+        # Live migration (ISSUE 18): opt-state carry. WorkerPlan's
+        # optimizer slots are per-plan-instance — a fresh plan would
+        # silently re-run opt_init on first _apply. When the dispatch is a
+        # migration re-plan over the SAME program, the master flags
+        # carry_state and names the stage indices that stayed on this
+        # worker; their slots survive the plan swap instead of resetting.
+        old_opt = None
+        if header.get("carry_state"):
+            old_opt = {}
+            if self.worker_plan is not None:
+                old_opt.update(getattr(self.worker_plan, "opt_states",
+                                       None) or {})
+            old_opt.update(self.adopted_opt)   # adopted slots win
+            keep = header.get("carry_stages")
+            if keep is not None:
+                keep = {int(s) for s in keep}
+                old_opt = {s: v for s, v in old_opt.items() if s in keep}
+        self.adopted_opt = {}
         # Each plan gets a FRESH RawStore: an old plan's still-running
         # run_step (e.g. a survivor blocked in a peer send past the abort
         # grace) keeps its reference to the ABORTED store and can neither
@@ -1086,6 +1109,8 @@ class TepdistServicer:
         self.plan_gen = int(header.get("plan_gen", self.plan_gen + 1))
         if header.get("plan_meta"):
             self.worker_plan = WorkerPlan(self, tasks, header["plan_meta"])
+            if old_opt:
+                self.worker_plan.opt_states = old_opt
         else:
             # A coordinator-style dispatch (tasks only, no plan_meta) must
             # not leave a stale WorkerPlan bound to the old aborted store:
@@ -1284,14 +1309,201 @@ class TepdistServicer:
             metrics().counter("transfers_freed_on_abort").inc(freed)
         return protocol.pack({"ok": True, "freed_transfers": freed})
 
+    # -- live migration (ISSUE 18) --------------------------------------
+    def FetchShard(self, request: bytes, context=None) -> bytes:
+        """Pure read of migration source state, riding the Frames
+        zero-copy path. Variable mode (``global_idx`` + optional
+        ``bounds`` slice in global coordinates) returns one literal;
+        ``opt_stage`` mode returns that stage's optimizer slots as a
+        multi-blob reply. ``wire_dtype`` applies the plan's comm_dtype
+        compression to the wire transfer (floats only). Naturally
+        idempotent — no token, deadline-retryable."""
+        header, _ = protocol.unpack(request)
+        self._inject_server_fault("FetchShard")
+        wire = header.get("wire_dtype")
+        opt_stage = header.get("opt_stage")
+        if opt_stage is not None:
+            slots = None
+            if self.worker_plan is not None:
+                slots = getattr(self.worker_plan, "opt_states",
+                                {}).get(int(opt_stage))
+            if slots is None:
+                slots = self.adopted_opt.get(int(opt_stage))
+            if slots is None:
+                return protocol.pack({"found": False})
+            metas, blobs = [], []
+            for slot in slots:
+                # np.asarray gathers @zero intra-mesh shards to host; the
+                # adopter's _apply re-pins them over ITS mesh at read time.
+                meta, blob = protocol.encode_literal(np.asarray(slot),
+                                                     wire_dtype=wire)
+                metas.append(meta)
+                blobs.append(blob)
+            return protocol.pack_frames({"found": True, "slots": metas},
+                                        blobs)
+        gi = int(header["global_idx"])
+        with self._lock:
+            arr = self.variables.get(gi)
+        if arr is None:
+            return protocol.pack({"found": False})
+        arr = np.asarray(arr)
+        bounds = header.get("bounds")
+        if bounds:
+            arr = arr[tuple(slice(int(lo), int(hi)) for lo, hi in bounds)]
+        meta, blob = protocol.encode_literal(arr, wire_dtype=wire)
+        return protocol.pack_frames({"found": True, "literal": meta},
+                                    [blob])
+
+    def _migration_peer(self, addr: str):
+        """Cached TepdistClient to a live migration source."""
+        peers = getattr(self, "_migration_peers", None)
+        if peers is None:
+            peers = self._migration_peers = {}
+        cli = peers.get(addr)
+        if cli is None:
+            from tepdist_tpu.rpc.client import TepdistClient
+            cli = peers[addr] = TepdistClient(addr)
+        return cli
+
+    def _ckpt_worker_data(self, step: int, worker_id: int, cache: Dict):
+        """Checkpoint-fallback source: one worker's restored dict at the
+        fenced step, loaded once per AdoptShard call. restore() reuses the
+        shard index to reassemble '::shard' (@zero shard-addressable)
+        entries into full host arrays."""
+        key = (int(step), int(worker_id))
+        if key not in cache:
+            from tepdist_tpu.runtime.checkpoint import CheckpointUtil
+            data, _ = CheckpointUtil(self.ckpt_dir).restore(
+                int(step), worker_id=int(worker_id))
+            cache[key] = data
+        return cache[key]
+
+    def _adopt_var(self, mv: Dict[str, Any], ckpt_cache: Dict):
+        from tepdist_tpu.parallel.redistribution import assemble_shard
+        srcs = mv["sources"]
+        dst_bounds = tuple((int(a), int(z)) for a, z in mv["dst_bounds"])
+        pieces = [(i, tuple((int(a), int(z)) for a, z in s["bounds"]))
+                  for i, s in enumerate(srcs)]
+
+        def fetch_src(i, abs_bounds):
+            s = srcs[i]
+            if s.get("addr"):
+                arr = self._migration_peer(s["addr"]).fetch_shard(
+                    int(mv["global_idx"]), bounds=abs_bounds,
+                    wire_dtype=mv.get("wire_dtype"))
+                if arr is None:
+                    raise KeyError(
+                        f"migration source {s['addr']} lost var "
+                        f"{mv['global_idx']}")
+                return arr
+            data = self._ckpt_worker_data(s["ckpt_step"], s["worker_id"],
+                                          ckpt_cache)
+            full = np.asarray(data[str(mv["global_idx"])])
+            return full[tuple(slice(lo, hi) for lo, hi in abs_bounds)]
+
+        return assemble_shard(dst_bounds, pieces, fetch_src,
+                              np.dtype(mv["dtype"]))
+
+    def _adopt_opt(self, mv: Dict[str, Any], ckpt_cache: Dict):
+        """Returns the source stage's slot list, or ``None`` when the
+        source holds NO state for that stage — a stateless optimizer
+        (SGD: zero slots) or a stage that never initialized; the adopter
+        then leaves lazy opt_init to produce the (empty) agreed state
+        instead of failing the whole migration."""
+        src_stage = int(mv.get("src_stage", mv["stage"]))
+        if mv.get("addr"):
+            return self._migration_peer(mv["addr"]).fetch_shard(
+                opt_stage=src_stage, wire_dtype=mv.get("wire_dtype"))
+        data = self._ckpt_worker_data(mv["ckpt_step"], mv["worker_id"],
+                                      ckpt_cache)
+        prefix = f"opt:{src_stage}:"
+        slots = {int(k.split(":")[2]): v for k, v in data.items()
+                 if k.startswith(prefix)}
+        if not slots:
+            return None
+        return [np.asarray(slots[j]) for j in sorted(slots)]
+
+    def AdoptShard(self, request: bytes, context=None) -> bytes:
+        """Destination side of a live shard move: pull the listed pieces
+        from live peers (nested FetchShard) or the shared checkpoint dir,
+        assemble each destination shard (parallel/redistribution.py), and
+        install variables / per-stage optimizer slots locally. Mutating —
+        idem-token deduped, so a transport-retried AdoptShard whose
+        original applied is answered from the cache, never re-installed.
+
+        Move schema (header["moves"] entries):
+          {"kind": "var", "global_idx": gi, "dst_bounds": [[lo,hi]..],
+           "dtype": name, "wire_dtype": opt, "sources": [
+               {"addr": "ip:port", "bounds": [[lo,hi]..]} |
+               {"ckpt_step": N, "worker_id": w, "bounds": [[lo,hi]..]}]}
+          {"kind": "opt", "stage": s, "src_stage": s_old,
+           "addr": ... | "ckpt_step"/"worker_id": ..., "wire_dtype": opt}
+        """
+        header, _ = protocol.unpack(request)
+        cached = self._idem_get(header)
+        if cached is not None:
+            return cached
+        # Injection BEFORE any install (mirrors the execute verbs): a
+        # post-install fault would only exercise the rpc retry + dedup
+        # cache, never an interrupted adoption.
+        self._inject_server_fault("AdoptShard")
+        ckpt_cache: Dict = {}
+        adopted = 0
+        for mv in header.get("moves", ()):
+            if mv["kind"] == "var":
+                arr = self._adopt_var(mv, ckpt_cache)
+                with self._lock:
+                    self.variables[int(mv["global_idx"])] = arr
+            elif mv["kind"] == "opt":
+                slots = self._adopt_opt(mv, ckpt_cache)
+                if slots is not None:
+                    # Staged for the migration's DispatchPlan carry merge
+                    # (the new WorkerPlan does not exist yet), and
+                    # mirrored into the live plan when one is installed.
+                    self.adopted_opt[int(mv["stage"])] = slots
+                    if self.worker_plan is not None:
+                        self.worker_plan.opt_states = getattr(
+                            self.worker_plan, "opt_states", {})
+                        self.worker_plan.opt_states[int(mv["stage"])] = \
+                            slots
+            else:
+                raise ValueError(f"unknown move kind {mv['kind']!r}")
+            adopted += 1
+        metrics().counter("shards_adopted").inc(adopted)
+        log.info("AdoptShard: %d moves (migration %s)", adopted,
+                 header.get("migration_id", "?"))
+        return self._idem_put(header, protocol.pack(
+            {"ok": True, "adopted": adopted,
+             "migration_id": header.get("migration_id", "")}))
+
     def Ping(self, request: bytes, context=None) -> bytes:
-        return protocol.pack({
+        header, _ = protocol.unpack(request)
+        out = {
             "ok": True,
             "task_index": self.task_index,
             "n_devices": len(self.devices),
             "platform": self.devices[0].platform,
             "global_step": self.global_step,
-        })
+        }
+        # Live migration checkpoint probe: the manifest lives in the
+        # WORKERS' shared checkpoint dir (the master's filesystem/env may
+        # not see it), so the planner asks over the wire. Opt-in — the
+        # heartbeat path must stay filesystem-free.
+        if header.get("want_ckpt_steps"):
+            from tepdist_tpu.runtime.checkpoint import CheckpointUtil
+            try:
+                out["ckpt_steps"] = [
+                    int(s) for s in CheckpointUtil(self.ckpt_dir).steps()]
+            except Exception:  # noqa: BLE001 — no manifest yet
+                out["ckpt_steps"] = []
+        # Live migration dirty-worker probe: the steps this plan already
+        # committed locally. A survivor that committed the failed step is
+        # AHEAD of the fleet's agreed state — the migration planner must
+        # rebase it from the checkpoint, not trust its in-memory shards.
+        if self.worker_plan is not None:
+            out["wp_completed"] = sorted(
+                getattr(self.worker_plan, "_completed", {}))
+        return protocol.pack(out)
 
     def GetTelemetry(self, request: bytes, context=None) -> bytes:
         """Pull this process's span ring + metrics snapshot. ``now_us``

@@ -46,7 +46,9 @@ class DistributedPipelineSession:
 
     def __init__(self, prog: PipelineProgram, cluster: ClusterSpec,
                  learning_rate: float = 0.01, optimizer=None,
-                 elastic: bool = False, autosave_every: int = 1):
+                 elastic: bool = False, autosave_every: int = 1,
+                 carry_state: bool = False,
+                 carry_stages: Optional[Dict[int, List[int]]] = None):
         """``optimizer``: an optax GradientTransformation; its init and
         update functions are TRACED per stage (over that stage's owned
         params) and shipped to workers as serialized jaxprs — any optax
@@ -60,7 +62,14 @@ class DistributedPipelineSession:
         SURVIVING cluster, restores the union of all workers' shards from
         the shared checkpoint directory, and retries the step — no manual
         ``resume()`` call. Requires a shared TEPDIST_CKPT_DIR (the same
-        contract the multi-worker save path already assumes)."""
+        contract the multi-worker save path already assumes).
+
+        ``carry_state``/``carry_stages`` (live migration, ISSUE 18):
+        when this session is the plan-swap half of a live migration, the
+        DispatchPlan tells each worker to CARRY the named stages'
+        optimizer slots across the plan swap (kept or just-adopted)
+        instead of letting the fresh WorkerPlan lazily re-run opt_init.
+        ``carry_stages`` maps task_index -> stage indices."""
         from tepdist_tpu.rpc.jaxpr_serde import serialize_closed_jaxpr
 
         self.prog = prog
@@ -256,17 +265,33 @@ class DistributedPipelineSession:
             # DispatchPlan whose original landed (response lost) must not
             # re-run — it would discard the fresh RawStore and any data
             # already pushed into it.
-            self.clients[ti].call("DispatchPlan", {
+            dispatch_hdr = {
                 "tasks": [serialize_task(n) for n in tasks],
                 "plan_meta": plan_meta,
                 "plan_gen": self._plan_gen,
-            })
+            }
+            if carry_state:
+                dispatch_hdr["carry_state"] = True
+                if carry_stages is not None:
+                    dispatch_hdr["carry_stages"] = sorted(
+                        carry_stages.get(ti, ()))
+            self.clients[ti].call("DispatchPlan", dispatch_hdr)
         self._step = 0
         self._step_attempts = 0
+        # Live migration state (ISSUE 18): revived workers queue here
+        # (via the health monitor's on_revive hook) and are folded back
+        # into the plan at the next step boundary; _known_workers keeps
+        # every spec ever seen so a revived task_index can be re-dialed
+        # after migrations shrank self.cluster past it.
+        self._pending_rejoin: set = set()
+        self._known_workers = {w.task_index: w for w in cluster.workers}
+        self._last_step_wall_ms = 0.0
+        self.last_migration: Optional[Dict[str, Any]] = None
         # Heartbeat monitor (surplus over the reference, which had no
         # failure detection at all — SURVEY §5.3).
         from tepdist_tpu.runtime.health import HealthMonitor
-        self.health = HealthMonitor(self.clients)
+        self.health = HealthMonitor(self.clients,
+                                    on_revive=self._note_revive)
         # Training-health sentinel: always on (the loss is already on
         # host each step, the check is a few float compares). The poller
         # thread is opt-in via TEPDIST_WATCH.
@@ -345,6 +370,10 @@ class DistributedPipelineSession:
         # master_step span gives the fidelity attribution the same frame:
         # without it, host serde on the push path (before any worker's
         # run_step opens) would be clamped out of the step window.
+        # A revived (or newly registered) worker folds back into the plan
+        # HERE, at the step boundary — the join half of live migration.
+        if self._elastic and self._pending_rejoin:
+            self._absorb_rejoin()
         step = self._step
         self._last_worker_ms = {}
         t0 = time.monotonic()
@@ -355,6 +384,7 @@ class DistributedPipelineSession:
         # straggler scorer's primary signal) — one histogram observe and
         # a deque append per step when the watchtower is active.
         wall_ms = (time.monotonic() - t0) * 1e3
+        self._last_step_wall_ms = wall_ms
         m = metrics()
         m.histogram("step_time_ms").observe(wall_ms)
         for ti, ms in self._last_worker_ms.items():
@@ -593,8 +623,22 @@ class DistributedPipelineSession:
                 raise RuntimeError(
                     f"elastic re-dispatch gave up after {attempts} "
                     f"attempts; worker failures: {errs}")
-            self._auto_redispatch()
             self._redispatch_attempts = attempts + 1
+            # Recovery rung 1: LIVE migration — replan over the survivors
+            # and reshard in place (worker→worker shard moves, no
+            # checkpoint round-trip, no rollback). Rung 2 on any failure:
+            # the checkpoint-restore re-dispatch.
+            try:
+                self._live_migrate()
+            except Exception as e:  # noqa: BLE001 — rung 2 handles it
+                from tepdist_tpu.runtime.migration import (
+                    MigrationInfeasible,
+                )
+                lvl = (log.warning if isinstance(e, MigrationInfeasible)
+                       else log.exception)
+                lvl("live migration failed (%r); falling back to "
+                    "checkpoint re-dispatch", e)
+                self._auto_redispatch()
             return self.step(*batch)   # retry on the new plan
         raise RuntimeError(
             f"worker failures: {errs}; dead={sorted(self.health.dead)}"
@@ -729,6 +773,265 @@ class DistributedPipelineSession:
                 self._autosave_every)
         log.warning("elastic re-dispatch complete: resumed at step %d",
                     self._step)
+
+    # ------------------------------------------------------------------
+    # Live plan migration (ISSUE 18): replan + reshard in place on fleet
+    # shape change — no checkpoint round-trip, no rollback. The heavy
+    # lifting (dirty probe, source-selection ladder, move planning) lives
+    # in runtime/migration.py; shard moves execute worker→worker over the
+    # FetchShard/AdoptShard verbs.
+    def _note_revive(self, ti: int) -> None:
+        """HealthMonitor on_revive hook: queue the worker for rejoin at
+        the next step boundary (never migrate from the heartbeat
+        thread — migration swaps the plan under the stepping thread)."""
+        if self._elastic:
+            self._pending_rejoin.add(ti)
+            log.warning("worker %d revived: queued for rejoin at the "
+                        "next step boundary", ti)
+
+    def _absorb_rejoin(self) -> None:
+        rejoin = sorted(self._pending_rejoin)
+        self._pending_rejoin.clear()
+        have = {w.task_index for w in self.cluster.workers}
+        specs = [self._known_workers[ti] for ti in rejoin
+                 if ti in self._known_workers and ti not in have]
+        for ti in rejoin:
+            self.health.revive(ti)
+        if not specs:
+            return
+        try:
+            self.migrate_to_fleet(
+                ClusterSpec(list(self.cluster.workers) + specs))
+        except Exception as e:  # noqa: BLE001 — rejoin is opportunistic
+            log.warning("rejoin migration failed (%r); continuing on the "
+                        "current fleet", e)
+
+    def register_worker(self, spec) -> Dict[str, Any]:
+        """Fold a NEW (or returned) worker into the running plan via live
+        migration. ``spec``: a WorkerSpec whose server is already up."""
+        self._known_workers[spec.task_index] = spec
+        workers = [w for w in self.cluster.workers
+                   if w.task_index != spec.task_index] + [spec]
+        return self.migrate_to_fleet(ClusterSpec(workers))
+
+    def _live_migrate(self) -> Dict[str, Any]:
+        from tepdist_tpu.runtime.migration import MigrationInfeasible
+        dead = set(self.health.dead)
+        survivors = [w for w in self.cluster.workers
+                     if w.task_index not in dead]
+        if not survivors:
+            raise MigrationInfeasible("no surviving workers to migrate "
+                                      "onto")
+        return self.migrate_to_fleet(ClusterSpec(survivors))
+
+    def _migration_budget_ms(self, moved_bytes: float) -> float:
+        """Stall budget ≈ one step wall + shard-move time (the ISSUE 18
+        target); the watchtower's stalled escalation fires past it. The
+        move term assumes a conservative 50 MB/s DCN floor."""
+        step_ms = self._last_step_wall_ms or 1000.0
+        return max(step_ms + moved_bytes / 50e6 * 1e3 + 2000.0, 5000.0)
+
+    def _replan_driver(self, new_cluster: ClusterSpec) -> Optional[str]:
+        """Re-run exploration on the new fleet shape (when this session
+        carries an exploration report) and name WHY the winner moved via
+        plan_diff; sessions built directly from a prog fall back to the
+        stage-remap driver (the s % W map itself changed)."""
+        report = getattr(self, "exploration_report", None)
+        if report:
+            try:
+                from tepdist_tpu.parallel.exploration import (
+                    replan_for_fleet,
+                )
+                new_report, diff = replan_for_fleet(
+                    report, new_cluster.total_devices,
+                    n_workers=new_cluster.num_workers)
+                self.exploration_report = new_report
+                return diff.get("driver")
+            except Exception as e:  # noqa: BLE001 — driver is advisory
+                log.warning("fleet replan failed (%r); using stage-remap "
+                            "driver", e)
+        if new_cluster.num_workers != self.cluster.num_workers:
+            return "candidate_set_change"
+        return None
+
+    def migrate_to_fleet(self, new_cluster: ClusterSpec) -> Dict[str, Any]:
+        """Migrate the running plan onto ``new_cluster`` in place: fence,
+        probe dirty workers, plan the shard moves, stream them
+        worker→worker (AdoptShard), then swap the plan (fresh dispatch
+        with carry_state) and resume at the SAME step — bit-exact
+        trajectory when no wire compression is configured (comm_dtype
+        set => banded, see TUTORIAL §20). Returns the migration record
+        (also kept as ``self.last_migration``)."""
+        from tepdist_tpu.runtime import migration
+        from tepdist_tpu.telemetry import watchtower
+        if self._params_template is None:
+            raise migration.MigrationInfeasible(
+                "live migration requires load_variables to have been "
+                "called")
+        t0 = time.monotonic()
+        self._migration_seq = getattr(self, "_migration_seq", 0) + 1
+        mig_id = f"mig{self._migration_seq}-step{self._step}"
+        driver = self._replan_driver(new_cluster)
+        template_flat = jax.tree_util.tree_leaves(self._params_template)
+        moved_bytes = sum(
+            float(np.prod(t.shape)) * np.dtype(t.dtype).itemsize
+            for t in template_flat)
+        watchtower.migration_started(
+            mig_id,
+            detail=(f"{self.cluster.num_workers} -> "
+                    f"{new_cluster.num_workers} workers at step "
+                    f"{self._step}"),
+            driver=driver,
+            budget_ms=self._migration_budget_ms(moved_bytes))
+        try:
+            stats = self._do_migrate(new_cluster, mig_id)
+        except Exception as e:  # noqa: BLE001 — alert then re-raise
+            watchtower.migration_completed(mig_id, failed=True,
+                                           detail=repr(e))
+            raise
+        stall_ms = (time.monotonic() - t0) * 1e3
+        m = metrics()
+        m.counter("elastic_migrations").inc()
+        m.gauge("migration_stall_ms").set(stall_ms)
+        m.histogram("migration_stall_ms").observe(stall_ms)
+        watchtower.migration_completed(mig_id, stall_ms=stall_ms)
+        self.last_migration = {"id": mig_id, "stall_ms": stall_ms,
+                               "driver": driver, "step": self._step,
+                               **stats}
+        log.warning("live migration %s complete in %.0f ms: %s", mig_id,
+                    stall_ms, stats)
+        return self.last_migration
+
+    def _do_migrate(self, new_cluster: ClusterSpec,
+                    mig_id: str) -> Dict[str, Any]:
+        from tepdist_tpu.runtime import migration
+        prog = self.prog
+        S = prog.num_stages
+        dead = set(self.health.dead)
+        template_flat = jax.tree_util.tree_leaves(self._params_template)
+        templates = [(tuple(t.shape), np.dtype(t.dtype).name)
+                     for t in template_flat]
+        # 1. Fence: latch the abort flag fleet-wide so any straggler
+        # still inside the fenced step abandons its STAGED writes — the
+        # dirty probe below then sees a stable committed/dirty split.
+        self._fence_fleet()
+        # 2. Dirty probe: survivors that already committed the fenced
+        # step locally are ahead of the agreed state.
+        dirty, unreachable, ckpt_steps = migration.probe_dirty(
+            self.clients, self._step, dead)
+        dead |= unreachable
+        new_workers = [w for w in new_cluster.workers
+                       if w.task_index not in dead]
+        if not new_workers:
+            raise migration.MigrationInfeasible(
+                "every destination worker is dead")
+        new_cluster = ClusterSpec(new_workers)
+        # 3. Checkpoint availability at EXACTLY the fenced step (the
+        # elastic autosave writes one per committed step) — the fallback
+        # source for state only dead/dirty workers hold. Probed through
+        # the workers' eyes (their shared checkpoint dir), not the
+        # master's filesystem.
+        ckpt_step = self._step if (self._step > 0
+                                   and self._step in ckpt_steps) else -1
+        # 4. Old/new fleet snapshots (placement re-derived with the same
+        # owner rule _assign_owners uses).
+        cons = migration.stage_param_consumers(prog)
+        n_params = len(template_flat)
+        old_pl, old_owner = migration.placement_for(
+            self.stage_worker, cons, n_params,
+            self.cluster.workers[0].task_index)
+        old = migration.FleetSnapshot(
+            list(self.stage_worker), old_pl, old_owner,
+            {w.task_index: w.address for w in self.cluster.workers})
+        W2 = new_cluster.num_workers
+        new_sw = [new_cluster.workers[s % W2].task_index
+                  for s in range(S)]
+        new_pl, new_owner = migration.placement_for(
+            new_sw, cons, n_params, new_cluster.workers[0].task_index)
+        new = migration.FleetSnapshot(
+            new_sw, new_pl, new_owner,
+            {w.task_index: w.address for w in new_cluster.workers})
+        # 5. Move plan: per-destination AdoptShard lists + the stages
+        # whose optimizer slots ride the DispatchPlan carry.
+        moves, carry = migration.plan_moves(
+            old, new, templates, dirty, dead, self._step, ckpt_step,
+            wire_dtype=self._wire_dtype)
+        # 6. Stream the shards worker→worker BEFORE the plan swap: the
+        # sources still hold the old plan's state, and adopted optimizer
+        # slots stage server-side for the carry merge.
+        adopt_errors: Dict[int, Exception] = {}
+
+        def adopt(ti: int, addr: str) -> None:
+            cli = self.clients.get(ti)
+            owned = cli is None
+            try:
+                if cli is None:   # joining worker: not in the old fleet
+                    cli = TepdistClient(addr)
+                cli.adopt_shard(moves[ti], migration_id=mig_id)
+            except Exception as e:  # noqa: BLE001
+                adopt_errors[ti] = e
+            finally:
+                if owned and cli is not None:
+                    cli.close()
+
+        threads = [threading.Thread(target=adopt,
+                                    args=(ti, new.addresses[ti]),
+                                    daemon=True)
+                   for ti in sorted(moves)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if adopt_errors:
+            raise migration.MigrationInfeasible(
+                f"shard adoption failed: "
+                f"{ {ti: repr(e) for ti, e in adopt_errors.items()} }")
+        # 7. Plan swap: fresh dispatch over the new fleet with
+        # carry_state (variables persist server-side; carried/adopted
+        # optimizer slots survive the WorkerPlan swap). Same
+        # session-rebuild dance as _auto_redispatch — WITHOUT the
+        # checkpoint restore and WITHOUT touching self._step.
+        self.health.stop()
+        for c in self.clients.values():
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        template = self._params_template
+        saved_step = self._step
+        elastic, autosave = self._elastic, self._autosave_every
+        attempts = getattr(self, "_redispatch_attempts", 0)
+        mig_seq = self._migration_seq
+        pending = set(self._pending_rejoin) - {w.task_index
+                                              for w in new_cluster.workers}
+        known = dict(self._known_workers)
+        known.update({w.task_index: w for w in new_cluster.workers})
+        report = getattr(self, "exploration_report", None)
+        fresh = DistributedPipelineSession(
+            prog, new_cluster, learning_rate=self.lr,
+            optimizer=self._optimizer, elastic=False,
+            carry_state=True, carry_stages=carry)
+        self.__dict__.update(fresh.__dict__)
+        self._elastic, self._autosave_every = elastic, autosave
+        self._redispatch_attempts = attempts
+        self._params_template = template
+        self._step = saved_step
+        self._migration_seq = mig_seq
+        self._pending_rejoin = pending
+        self._known_workers = known
+        if report is not None:
+            self.exploration_report = report
+        self._assign_owners(template)
+        # Re-bind the revive hook to THIS session (fresh's hook is gated
+        # off by its elastic=False construction).
+        self.health.on_revive = self._note_revive
+        stats = migration.summarize(moves)
+        stats.update({"dirty": sorted(dirty), "dead": sorted(dead),
+                      "ckpt_step": ckpt_step,
+                      "carried_stages": sum(map(len, carry.values())),
+                      "new_workers": [w.task_index
+                                      for w in new_cluster.workers]})
+        return stats
 
     # ------------------------------------------------------------------
     # Checkpoint + elastic recovery (beyond the reference: SURVEY §5.3

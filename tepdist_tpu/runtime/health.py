@@ -36,12 +36,17 @@ class HealthMonitor:
                  interval_s: float = 5.0,
                  timeout_s: float = 3.0,
                  max_misses: int = 2,
-                 on_failure: Optional[Callable[[int, Exception], None]] = None):
+                 on_failure: Optional[Callable[[int, Exception], None]] = None,
+                 on_revive: Optional[Callable[[int], None]] = None):
         self.clients = clients
         self.interval = interval_s
         self.timeout = timeout_s
         self.max_misses = max_misses
         self.on_failure = on_failure
+        # Fired (outside the lock, like on_failure) when a dead worker's
+        # heartbeat answers again — the elastic session's hook to fold a
+        # revived worker back into the plan via live migration.
+        self.on_revive = on_revive
         self.misses: Dict[int, int] = {ti: 0 for ti in clients}
         self.dead: set = set()
         self.last_seen: Dict[int, float] = {}
@@ -62,6 +67,11 @@ class HealthMonitor:
         from tepdist_tpu.telemetry import metrics
         metrics().counter("worker_revived").inc()
         log.warning("worker %d revived (heartbeat answered again)", ti)
+        if self.on_revive is not None:
+            try:
+                self.on_revive(ti)
+            except Exception:  # noqa: BLE001
+                log.exception("on_revive callback raised")
 
     def mark_dead(self, tis: Sequence[int]) -> None:
         """Declare workers dead from outside the heartbeat loop (the

@@ -94,6 +94,7 @@ KIND_NAN = "nan"
 KIND_LOSS_SPIKE = "loss_spike"
 KIND_SLO_BURN = "slo_burn"
 KIND_FLEET_SHAPE = "fleet_shape"
+KIND_MIGRATION = "migration"
 
 
 @dataclasses.dataclass
@@ -201,6 +202,83 @@ def active_alerts() -> List[Dict[str, Any]]:
     """JSON-safe active alerts — what GetTelemetry(Delta) responses and
     the merged-trace ``alerts`` metadata carry."""
     return [a.to_dict() for a in _BOARD.active()]
+
+
+# -- live-migration alert lifecycle (ISSUE 18) -------------------------------
+#
+# The elastic executor brackets each live plan migration with
+# migration_started / migration_completed. The started alert is keyed by
+# migration id (dedup on the board, watch_alert:migration gauge via the
+# board's publish path); a daemon Timer escalates it to a "stalled" page
+# if the stall budget elapses before completion; completion updates the
+# detail and resolves the key (gauge back to 0). The LATEST migration id
+# stays readable via migration_context() so the StragglerScorer's
+# fleet_shape alerts can reference which migration reshaped the fleet.
+
+_MIGRATION_CTX: Optional[str] = None
+_MIGRATION_TIMERS: Dict[str, threading.Timer] = {}
+
+
+def set_migration_context(mig_id: Optional[str]) -> None:
+    global _MIGRATION_CTX
+    _MIGRATION_CTX = mig_id
+
+
+def migration_context() -> Optional[str]:
+    return _MIGRATION_CTX
+
+
+def migration_started(mig_id: str, detail: str = "",
+                      driver: Optional[str] = None,
+                      budget_ms: Optional[float] = None) -> HealthAlert:
+    set_migration_context(mig_id)
+    d = f"migration {mig_id} started"
+    if driver:
+        d += f" (driver {driver})"
+    if detail:
+        d += f": {detail}"
+    alert = HealthAlert(kind=KIND_MIGRATION, name=mig_id, detail=d)
+    out = _BOARD.publish(alert)
+    metrics().counter("migrations_started").inc()
+    if budget_ms:
+        t = threading.Timer(budget_ms / 1e3, _migration_stalled,
+                            args=(mig_id, budget_ms))
+        t.daemon = True
+        _MIGRATION_TIMERS[mig_id] = t
+        t.start()
+    return out
+
+
+def _migration_stalled(mig_id: str, budget_ms: float) -> None:
+    _BOARD.publish(HealthAlert(
+        kind=KIND_MIGRATION, name=mig_id, severity="page",
+        threshold=budget_ms,
+        detail=(f"migration {mig_id} STALLED: still running past the "
+                f"{budget_ms:.0f} ms stall budget")))
+    metrics().counter("migrations_stalled").inc()
+
+
+def migration_completed(mig_id: str, stall_ms: Optional[float] = None,
+                        failed: bool = False,
+                        detail: str = "") -> None:
+    t = _MIGRATION_TIMERS.pop(mig_id, None)
+    if t is not None:
+        t.cancel()
+    if failed:
+        # Left ACTIVE (page): the executor is falling to the checkpoint
+        # rollback rung — the operator should see why.
+        _BOARD.publish(HealthAlert(
+            kind=KIND_MIGRATION, name=mig_id, severity="page",
+            detail=(f"migration {mig_id} FAILED"
+                    + (f": {detail}" if detail else ""))))
+        metrics().counter("migrations_failed").inc()
+        return
+    _BOARD.publish(HealthAlert(
+        kind=KIND_MIGRATION, name=mig_id, value=stall_ms,
+        detail=(f"migration {mig_id} completed"
+                + (f" in {stall_ms:.0f} ms" if stall_ms is not None
+                   else ""))))
+    _BOARD.resolve(f"{KIND_MIGRATION}:{mig_id}")
 
 
 # -- training-health sentinels ----------------------------------------------
@@ -353,10 +431,16 @@ class StragglerScorer:
         if self._known and known != self._known:
             gone = sorted(self._known - known)
             new = sorted(known - self._known)
+            detail = (f"fleet shape changed: -{gone} +{new}"
+                      if gone else f"fleet shape changed: +{new}")
+            # Fleet-shape events name the migration that reshaped the
+            # fleet (when one ran) so the two alert streams join.
+            ctx = migration_context()
+            if ctx:
+                detail += f" (migration {ctx})"
             alert = HealthAlert(
                 kind=KIND_FLEET_SHAPE, severity="page" if gone else "warn",
-                detail=(f"fleet shape changed: -{gone} +{new}"
-                        if gone else f"fleet shape changed: +{new}"))
+                detail=detail)
             alerts.append(self._board.publish(alert))
         self._known = known
         return alerts

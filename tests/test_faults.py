@@ -393,6 +393,43 @@ def test_dropped_response_is_replayed_and_deduped():
         close_inproc_cluster(cluster)
 
 
+def test_adopt_shard_replay_dedup():
+    """Migration's mutating verb sits behind the same dedup cache as the
+    execute verbs: replaying an AdoptShard token returns the original
+    bytes and installs the shard exactly once."""
+    metrics().reset()
+    cluster, servicers = make_inproc_cluster(2, devices=jax.devices()[:1])
+    try:
+        src = np.arange(6, dtype=np.float32)
+        with servicers[0]._lock:
+            servicers[0].variables[0] = src
+        from tepdist_tpu.rpc.client import TepdistClient
+
+        cli = TepdistClient(cluster.workers[1].address)
+        hdr = {"moves": [{"kind": "var", "global_idx": 0,
+                          "dst_bounds": [[0, 6]], "dtype": "float32",
+                          "sources": [{"addr": cluster.workers[0].address,
+                                       "bounds": [[0, 6]]}]}],
+               "migration_id": "mig-test",
+               "idem": "testclient:AdoptShard:1"}
+        resp1 = cli.call("AdoptShard", dict(hdr))
+        np.testing.assert_array_equal(servicers[1].variables[0], src)
+        # Scribble over the installed shard, then replay the SAME token:
+        # answered from the cache — identical bytes, no re-install.
+        with servicers[1]._lock:
+            servicers[1].variables[0] = np.zeros(6, dtype=np.float32)
+        resp2 = cli.call("AdoptShard", dict(hdr))
+        assert resp2 == resp1
+        np.testing.assert_array_equal(servicers[1].variables[0],
+                                      np.zeros(6, dtype=np.float32))
+        snap = metrics().snapshot()["counters"]
+        assert snap["shards_adopted"] == 1
+        assert snap["dedup_hits"] >= 1
+        cli.close()
+    finally:
+        close_inproc_cluster(cluster)
+
+
 # ---------------------------------------------------------------------------
 # Acceptance: two-worker pipeline under chaos matches fault-free bit-for-bit
 # ---------------------------------------------------------------------------

@@ -36,6 +36,7 @@ from tepdist_tpu.parallel.performance_utils import (
     TpuChipSpec,
     param_wire_dtype,
 )
+from tepdist_tpu.parallel.redistribution import plan_redistribution
 from tepdist_tpu.parallel.sync_free import build_ga_step, zero_pad_params
 from tepdist_tpu.runtime.checkpoint import CheckpointUtil
 
@@ -404,6 +405,29 @@ def test_checkpoint_zero_state_restores_onto_wider_dp(tmp_path, devices):
     dsts = [[[i * 4, (i + 1) * 4]] for i in range(4)]
     out, step = util.restore_resharded({"opt.mu": dsts})
     assert step == 1
+    for d, got in zip(dsts, out["opt.mu"]):
+        (lo, hi), = d
+        np.testing.assert_array_equal(got, full[lo:hi])
+
+
+def test_checkpoint_zero_state_restores_onto_narrower_dp(tmp_path, devices):
+    """The elastic-shrink direction of the reshard contract: dp=4 ZeRO
+    shards land on dp=2 destination bounds. Each destination spans TWO
+    source shards, so plan_redistribution must stitch multi-piece
+    assemblies — the path a fleet-shrink live migration rides."""
+    mesh = Mesh(np.array(devices[:4]), ("data",))
+    full = np.arange(16, dtype=np.float32)
+    mu = jax.device_put(jnp.asarray(full), NamedSharding(mesh, P("data")))
+    util = CheckpointUtil(str(tmp_path), shard_addressable=True)
+    util.save(5, {"opt.mu": mu})
+    # The shard index on disk holds four dp=4 pieces; the dp=2 plan
+    # stitches two of them per destination.
+    src = [((i * 4, (i + 1) * 4),) for i in range(4)]
+    plan = plan_redistribution(src, [((0, 8),), ((8, 16),)])
+    assert all(len(pieces) == 2 for pieces in plan), plan
+    dsts = [[[i * 8, (i + 1) * 8]] for i in range(2)]
+    out, step = util.restore_resharded({"opt.mu": dsts})
+    assert step == 5
     for d, got in zip(dsts, out["opt.mu"]):
         (lo, hi), = d
         np.testing.assert_array_equal(got, full[lo:hi])

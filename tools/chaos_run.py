@@ -27,6 +27,13 @@ Examples:
     python tools/chaos_run.py --spec 'rpc_drop:p=0.2,seed=7;rpc_delay:ms=5'
     python tools/chaos_run.py --serve --requests 10 \
         --spec 'engine_crash:step=3,ti=0;serve_fault:op=decode,step=6,ti=1'
+    python tools/chaos_run.py --steps 6 --kill-worker 3
+
+``--kill-worker STEP`` is the elastic arm (ISSUE 18): REAL gRPC worker
+subprocesses, one SIGKILLed mid-run; asserts the session completes on the
+reshaped mesh via exactly one live migration (no checkpoint rollback)
+with the trajectory of an undisturbed run, and prints the
+``migration_stall_ms=`` line scripts/elastic_smoke.sh records.
 """
 
 from __future__ import annotations
@@ -124,6 +131,144 @@ def run_serve(requests: int, workers: int, slots: int, spec=None):
         close_inproc_cluster(cluster)
 
 
+def kill_worker_chaos(args) -> int:
+    """Elastic live-migration arm (ISSUE 18): run the pipeline over REAL
+    worker subprocesses (gRPC, not in-proc), SIGKILL one mid-run, and
+    assert the session completes on the reshaped mesh via exactly one
+    LIVE migration — no checkpoint rollback — with the loss trajectory
+    matching an undisturbed local reference (DP width is unchanged here,
+    so the elastic contract is bit-level-equivalent numerics)."""
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+
+    import optax
+
+    from tepdist_tpu.core.cluster_spec import ClusterSpec, WorkerSpec
+    from tepdist_tpu.parallel.pipeline import plan_pipeline
+    from tepdist_tpu.rpc.client import TepdistClient
+    from tepdist_tpu.runtime.distributed_executor import (
+        DistributedPipelineSession,
+    )
+    from tepdist_tpu.telemetry import metrics
+
+    kill_step = args.kill_worker
+    if not 0 < kill_step < args.steps:
+        print(f"FAIL: --kill-worker {kill_step} must fall strictly inside "
+              f"the run (0 < STEP < --steps {args.steps})")
+        return 1
+    loss_fn, params, x, y = _build_case(args.stages, args.micro)
+    prog = plan_pipeline(loss_fn, args.stages, args.micro, params, x, y)
+    tx = optax.adam(1e-2)   # stateful: moments must survive the move
+
+    # Undisturbed reference trajectory (same jaxprs, local jit).
+    def apply_fn(pp, ss, g):
+        u, ss = tx.update(g, ss, pp)
+        return optax.apply_updates(pp, u), ss
+
+    ref_step = jax.jit(prog.reference_step(apply_fn))
+    p, s = params, tx.init(params)
+    baseline = []
+    for _ in range(args.steps):
+        loss, p, s = ref_step(p, s, x, y)
+        baseline.append(float(loss))
+
+    def free_port():
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            return sk.getsockname()[1]
+
+    ckpt_dir = tempfile.mkdtemp(prefix="tepdist_chaos_ckpt_")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TEPDIST_CKPT_DIR"] = ckpt_dir   # SHARED: migration's fallback
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ports = [free_port() for _ in range(args.stages)]
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "tepdist_tpu.rpc.server",
+         "--port", str(port), "--platform", "cpu",
+         "--task_index", str(ti)],
+        env=env, cwd=root,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for ti, port in enumerate(ports)]
+    metrics().reset()
+    try:
+        for port in ports:
+            c = TepdistClient(f"127.0.0.1:{port}")
+            c.wait_ready(60)
+            c.close()
+        cluster = ClusterSpec([
+            WorkerSpec("127.0.0.1", port, [0], task_index=ti)
+            for ti, port in enumerate(ports)])
+        print(f"chaos: {args.stages} worker subprocesses up; SIGKILL of "
+              f"worker {args.stages - 1} lands after step {kill_step}")
+        sess = DistributedPipelineSession(prog, cluster, optimizer=tx,
+                                          elastic=True, autosave_every=1)
+        sess.health.interval = 0.5
+        sess.load_variables(params)
+        losses = []
+        for i in range(args.steps):
+            if i == kill_step:
+                victim = procs[-1]
+                victim.send_signal(signal.SIGKILL)
+                victim.wait()
+            losses.append(sess.step(x, y))
+        survivors = sess.cluster.num_workers
+        mig = sess.last_migration
+        sess.close()
+    finally:
+        for pr in procs:
+            pr.send_signal(signal.SIGKILL)
+            pr.wait()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    snap = metrics().snapshot()
+    counters = snap["counters"]
+    print("elastic migration counters:")
+    for k in sorted(counters):
+        if k.split(":")[0] in ("elastic_migrations", "elastic_redispatch",
+                               "checkpoint_rollback_steps", "step_retries",
+                               "shards_adopted", "migrations_started",
+                               "migrations_stalled", "migrations_failed"):
+            print(f"  {k:<32} {counters[k]}")
+    stall = snap["gauges"].get("migration_stall_ms")
+    if stall is not None:
+        # Machine-readable: scripts/elastic_smoke.sh greps this line into
+        # the perf-gate bench history.
+        print(f"migration_stall_ms={stall:.3f}")
+
+    ok = True
+    if survivors != args.stages - 1:
+        ok = False
+        print(f"FAIL: expected the reshaped mesh to hold "
+              f"{args.stages - 1} workers, found {survivors}")
+    if counters.get("elastic_migrations", 0) != 1:
+        ok = False
+        print(f"FAIL: expected exactly 1 live migration, counted "
+              f"{counters.get('elastic_migrations', 0)} "
+              f"(redispatch fallback: "
+              f"{counters.get('elastic_redispatch', 0)})")
+    if counters.get("checkpoint_rollback_steps"):
+        ok = False
+        print("FAIL: live migration must not roll back to a checkpoint")
+    if not np.allclose(losses, baseline, rtol=1e-4):
+        ok = False
+        print("FAIL: loss trajectory diverged from the undisturbed run")
+        for i, (a, b) in enumerate(zip(baseline, losses)):
+            mark = "" if np.isclose(a, b, rtol=1e-4) else "   <-- diverged"
+            print(f"  step {i}: clean={a!r} chaos={b!r}{mark}")
+    else:
+        print(f"loss trajectory matches the undisturbed run over "
+              f"{args.steps} steps through the migration "
+              f"(final loss {losses[-1]:.6f}"
+              + (f", stall {mig['stall_ms']:.0f} ms" if mig else "")
+              + ")")
+    print("PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
 def serve_chaos(args) -> int:
     from tepdist_tpu.telemetry import metrics
 
@@ -183,7 +328,13 @@ def main() -> int:
                     help="(--serve) request count")
     ap.add_argument("--slots", type=int, default=2,
                     help="(--serve) KV-cache slots per worker")
+    ap.add_argument("--kill-worker", type=int, default=None, metavar="STEP",
+                    help="elastic arm: SIGKILL a real worker subprocess "
+                         "after STEP steps and assert completion on the "
+                         "reshaped mesh via one LIVE migration")
     args = ap.parse_args()
+    if args.kill_worker is not None:
+        return kill_worker_chaos(args)
     if args.serve:
         if args.spec is None:
             args.spec = ("engine_crash:step=3,ti=0;"
