@@ -549,3 +549,63 @@ def verify_servable(cfg, *, slots: int, max_len: int,
             f"{hbm_limit_bytes / 1e9:.3f} GB{' at ' + where if where else ''}")
     from tepdist_tpu.telemetry import metrics
     metrics().counter("plan_verified").inc()
+
+
+def verify_sharded_servable(cfg, *, stages, max_len: int,
+                            hbm_limit_bytes: Optional[float] = None,
+                            dtype_bytes: Optional[int] = None,
+                            where: str = "") -> Dict[int, float]:
+    """The sharded arm of ``verify_servable`` (ISSUE 19): per-STAGE fit
+    instead of whole-model fit. ``stages`` is a sequence of
+    ``(lo, hi, first, last)`` layer ranges — the fleet loader passes all
+    of them, a worker receiving one stage passes just its own. Per stage:
+    12*d^2 transformer weights per layer, the embedding tables where they
+    physically live (wte+wpe on the FIRST stage; wte again plus ln_f on
+    the LAST — the tied logits matmul needs its own copy), and a
+    [layers, 1, n_head, max_len, head_dim] k/v cache pair. Raises
+    ``hbm_overflow`` naming the offending stage; returns the per-stage
+    byte footprints for the planner's records."""
+    if max_len < 1:
+        raise PlanVerificationError(
+            "servable", f"max_len must be positive, got {max_len}")
+    if hbm_limit_bytes is None:
+        from tepdist_tpu.parallel.performance_utils import chip_spec
+        hbm_limit_bytes = chip_spec().hbm_gb * 1e9
+    if dtype_bytes is None:
+        try:
+            import numpy as np
+            dtype_bytes = int(np.dtype(getattr(cfg, "dtype",
+                                               "float32")).itemsize)
+        except TypeError:
+            dtype_bytes = 4
+    d_model = int(getattr(cfg, "d_model", getattr(cfg, "n_embd", 0)))
+    vocab = int(getattr(cfg, "vocab_size", 0))
+    n_ctx = int(getattr(cfg, "n_ctx", max_len))
+    out: Dict[int, float] = {}
+    for s, (lo, hi, first, last) in enumerate(stages):
+        layers = int(hi) - int(lo)
+        if layers < 1:
+            raise PlanVerificationError(
+                "servable", f"stage {s} has empty layer range "
+                            f"[{lo}, {hi})")
+        weight_bytes = float(12 * layers * d_model * d_model
+                             + 13 * layers * d_model) * dtype_bytes
+        if first:
+            weight_bytes += float(vocab * d_model
+                                  + n_ctx * d_model) * dtype_bytes
+        if last:
+            weight_bytes += float(vocab * d_model + 2 * d_model) \
+                * dtype_bytes
+        kv_bytes = 2.0 * max_len * layers * d_model * dtype_bytes
+        out[s] = kv_bytes + weight_bytes
+        if hbm_limit_bytes > 0 and out[s] > hbm_limit_bytes:
+            raise PlanVerificationError(
+                "hbm_overflow",
+                f"stage {s} (layers [{lo}, {hi})) KV "
+                f"({kv_bytes / 1e9:.4f} GB) + weights "
+                f"({weight_bytes / 1e9:.4f} GB) exceed per-device HBM "
+                f"{hbm_limit_bytes / 1e9:.4f} GB"
+                f"{' at ' + where if where else ''}")
+    from tepdist_tpu.telemetry import metrics
+    metrics().counter("plan_verified").inc()
+    return out

@@ -50,7 +50,12 @@ IDEMPOTENT_TOKEN_VERBS = {"ExecutePlan", "DispatchPlan",
                           # answer from the cache, never re-pull and
                           # re-install (FetchShard is a pure read and
                           # carries no token).
-                          "AdoptShard"}
+                          "AdoptShard",
+                          # Disaggregated serving: a replayed AdoptPages
+                          # must not re-pull and re-install a request's KV
+                          # pages (ExportPages' gather is a pure read and
+                          # its release is state-idempotent — no token).
+                          "AdoptPages"}
 
 
 class GRPCStub:
@@ -323,7 +328,8 @@ class TepdistClient:
                       n_pages: Optional[int] = None,
                       hbm_budget_bytes: Optional[float] = None,
                       prefix_cache: bool = True,
-                      prefill_chunk: Optional[int] = None) -> str:
+                      prefill_chunk: Optional[int] = None,
+                      stage: Optional[Dict[str, Any]] = None) -> str:
         """Ship a model (JSON-able GPT2Config dict + flat param leaves in
         tree_flatten order) and start its supervised serving engine.
         Returns the servable id used by the other serve verbs.
@@ -332,7 +338,10 @@ class TepdistClient:
         half of it). ``kv_mode``/``page_size``/``n_pages``/
         ``hbm_budget_bytes``/``prefix_cache``/``prefill_chunk`` pick the
         KV substrate: block-paged with prefix sharing and chunked
-        prefill (default) or the fixed-slot fallback."""
+        prefill (default) or the fixed-slot fallback. ``stage`` loads a
+        pipeline-STAGE servable instead of a whole-model engine: a dict
+        ``{"lo", "hi", "first", "last", "names"}`` naming the layer range
+        and the dotted param leaves being shipped (serving/fleet.py)."""
         metas, blobs = [], []
         for leaf in param_leaves:
             meta, blob = protocol.encode_literal(np.asarray(leaf))
@@ -348,7 +357,8 @@ class TepdistClient:
             "kv_mode": kv_mode, "page_size": int(page_size),
             "n_pages": n_pages, "hbm_budget_bytes": hbm_budget_bytes,
             "prefix_cache": bool(prefix_cache),
-            "prefill_chunk": prefill_chunk}, blobs)
+            "prefill_chunk": prefill_chunk,
+            "stage": stage}, blobs)
         header, _ = protocol.unpack(resp)
         return header["servable_id"]
 
@@ -357,7 +367,8 @@ class TepdistClient:
                        temperature: float = 1.0, top_k: int = 0,
                        seed: int = 0,
                        deadline_ms: Optional[float] = None,
-                       slo_class: str = "default"
+                       slo_class: str = "default",
+                       prefill_only: bool = False
                        ) -> Dict[str, Any]:
         meta, blob = protocol.encode_literal(
             np.asarray(prompt, np.int32).reshape(-1))
@@ -367,7 +378,8 @@ class TepdistClient:
             "greedy": bool(greedy), "temperature": float(temperature),
             "top_k": int(top_k), "seed": int(seed),
             "deadline_ms": deadline_ms,
-            "slo_class": str(slo_class)}, [blob])
+            "slo_class": str(slo_class),
+            "prefill_only": bool(prefill_only)}, [blob])
         header, _ = protocol.unpack(resp)
         return header
 
@@ -436,6 +448,74 @@ class TepdistClient:
                          {"moves": moves, "migration_id": migration_id})
         header, _ = protocol.unpack(resp)
         return header
+
+    # -- disaggregated serving (KV handoff + sharded servables) --------
+    def export_pages(self, servable_id: str, request_id: str, *,
+                     want: Optional[Sequence[int]] = None,
+                     release: bool = False,
+                     wire_dtype: Optional[str] = None
+                     ) -> Optional[Dict[str, Any]]:
+        """Gather a prefilled request's live KV pages from the prefill
+        replica (pure read, like fetch_shard). ``want`` selects live-page
+        ordinals (0-based within the request's page table) so prefix-hit
+        pages the adopter already holds are never re-shipped. With
+        ``release=True`` the source request flips to "handed_off" and its
+        pages are freed (state-idempotent) — returns {"released": bool}.
+        Gather mode returns None when the request is not exportable."""
+        resp = self.call("ExportPages", {
+            "servable_id": servable_id, "request_id": request_id,
+            "want": list(want) if want is not None else None,
+            "release": bool(release), "wire_dtype": wire_dtype})
+        header, blobs = protocol.unpack(resp)
+        if release:
+            return {"released": bool(header.get("released"))}
+        if not header.get("found"):
+            return None
+        return {"first_token": int(header["first_token"]),
+                "pos": int(header["pos"]),
+                "n_live": int(header["n_live"]),
+                "idx": list(header["idx"]),
+                "k": protocol.decode_literal(header["k"], blobs[0]),
+                "v": protocol.decode_literal(header["v"], blobs[1])}
+
+    def adopt_pages(self, servable_id: str, request_id: str, prompt, *,
+                    source_addr: str, source_sid: str,
+                    max_new_tokens: int, greedy: bool = True,
+                    temperature: float = 1.0, top_k: int = 0,
+                    seed: int = 0, deadline_ms: Optional[float] = None,
+                    slo_class: str = "default",
+                    wire_dtype: Optional[str] = None) -> Dict[str, Any]:
+        """Instruct the decode replica to pull the request's live KV
+        pages from ``source_addr``/``source_sid`` (nested ExportPages),
+        install them into its PagePool, and resume decode. Mutating —
+        rides the idem token so a replay is answered from the dedup
+        cache, never re-pulled/re-installed."""
+        meta, blob = protocol.encode_literal(
+            np.asarray(prompt, np.int32).reshape(-1))
+        resp = self.call("AdoptPages", {
+            "servable_id": servable_id, "request_id": request_id,
+            "prompt": meta, "source_addr": source_addr,
+            "source_sid": source_sid,
+            "max_new_tokens": int(max_new_tokens),
+            "greedy": bool(greedy), "temperature": float(temperature),
+            "top_k": int(top_k), "seed": int(seed),
+            "deadline_ms": deadline_ms, "slo_class": str(slo_class),
+            "wire_dtype": wire_dtype}, [blob])
+        header, _ = protocol.unpack(resp)
+        return header
+
+    def execute_servable_slice(self, servable_id: str, op: str,
+                               array, pos: int = 0) -> np.ndarray:
+        """Run one ``op`` ("prefill" | "decode") of a pipeline-STAGE
+        servable: tokens int32 [1, S] into the first stage, hidden
+        activations [1, S, d] into later ones; exact activation bytes
+        ride back on the Frames path (bit-identity contract)."""
+        meta, blob = protocol.encode_literal(np.asarray(array))
+        resp = self.call("ExecuteServableSlice", {
+            "servable_id": servable_id, "op": str(op),
+            "array": meta, "pos": int(pos)}, [blob])
+        header, blobs = protocol.unpack(resp)
+        return protocol.decode_literal(header["out"], blobs[0])
 
     # -- checkpoint ----------------------------------------------------
     def do_remote_save(self, max_to_keep: int = 5,

@@ -65,6 +65,21 @@ RPC surface (method -> reference RPC):
   PollResult            -> (serving: long-poll request states/tokens —
                            a pure read, naturally idempotent)
   CancelRequest         -> (serving: cancel a queued/active request)
+  ExportPages           -> (serving fleet: gather a prefilled request's
+                           live KV pages as Frames blobs — a pure read,
+                           like FetchShard; a ``release`` call flips the
+                           source request to "handed_off" and frees its
+                           pages — naturally idempotent by state machine)
+  AdoptPages            -> (serving fleet: the decode replica pulls a
+                           prefilled request's KV pages from the prefill
+                           replica — nested ExportPages, like AdoptShard's
+                           nested FetchShards — installs them into its
+                           PagePool and resumes decode. Mutating: idem
+                           token + server dedup + NO_DEADLINE_RETRY)
+  ExecuteServableSlice  -> (serving fleet: run one prefill/decode step of
+                           a pipeline-STAGE servable — the serving twin of
+                           ExecuteStepSlice's coalesced dispatch; exact
+                           activation bytes ride the Frames path)
 
 Retry + idempotency (rpc/retry.py, no reference analogue): mutating verbs
 (ExecutePlan, DispatchPlan, TransferToServerHost, LoadServable,
@@ -117,6 +132,9 @@ METHODS = [
     "Drain",
     "FetchShard",
     "AdoptShard",
+    "ExportPages",
+    "AdoptPages",
+    "ExecuteServableSlice",
 ]
 
 # Reference keeps INT_MAX message sizes (client_library.cc:152-156).

@@ -76,6 +76,13 @@ DEADLINES = {
     # destination shard set (nested FetchShards or checkpoint reads).
     "FetchShard": 120.0,
     "AdoptShard": 300.0,
+    # Disaggregated serving (ISSUE 19): ExportPages is a pure KV-page read
+    # sized like FetchShard; AdoptPages pulls + installs a whole request's
+    # page set (nested ExportPages); ExecuteServableSlice runs one stage
+    # step of a sharded servable (execute-class budget).
+    "ExportPages": 120.0,
+    "AdoptPages": 300.0,
+    "ExecuteServableSlice": 600.0,
 }
 DEFAULT_DEADLINE = 300.0
 
@@ -90,7 +97,14 @@ NO_DEADLINE_RETRY = {"ExecutePlan", "ExecuteRemotePlan",
                      # blind replay would race the original (the idem
                      # cache only absorbs COMPLETED originals). FetchShard
                      # stays deadline-retryable: it is a pure read.
-                     "AdoptShard"}
+                     "AdoptShard",
+                     # AdoptPages mirrors AdoptShard (nested ExportPages
+                     # pulls may still be assembling at the deadline), and
+                     # ExecuteServableSlice is an execute verb: a blind
+                     # replay would race the original stage step.
+                     # ExportPages stays deadline-retryable: the gather is
+                     # a pure read and the release is state-idempotent.
+                     "AdoptPages", "ExecuteServableSlice"}
 
 
 def deadline_for(method: str, override: Optional[float] = None) -> float:

@@ -1603,6 +1603,9 @@ class TepdistServicer:
         cfg = config_from_spec(header["config"])
         leaves = [protocol.decode_literal(m, blobs[i])
                   for i, m in enumerate(header["params_meta"])]
+        stage = header.get("stage")
+        if stage is not None:
+            return self._load_stage_servable(header, cfg, leaves, stage)
         sds = jax.eval_shape(
             lambda: gpt2.init_params(cfg, jax.random.PRNGKey(0)))
         tree = jax.tree_util.tree_structure(sds)
@@ -1656,6 +1659,37 @@ class TepdistServicer:
         return self._idem_put(header, protocol.pack(
             {"ok": True, "servable_id": sid, **eng.stats()}))
 
+    def _load_stage_servable(self, header, cfg, leaves, stage) -> bytes:
+        """Sharded arm of LoadServable: install ONE pipeline stage (a
+        layer range plus the embedding/logit tables it owns) as a
+        StageServable driven over ExecuteServableSlice, instead of a
+        whole-model engine. The planner-priced split was verified
+        fleet-wide client-side; each worker re-verifies just ITS stage
+        against the local HBM budget."""
+        from tepdist_tpu.analysis.plan_verify import (
+            verify_enabled, verify_sharded_servable)
+        from tepdist_tpu.serving.fleet import (StageServable,
+                                               build_stage_params)
+        lo, hi = int(stage["lo"]), int(stage["hi"])
+        first, last = bool(stage["first"]), bool(stage["last"])
+        max_len = int(header.get("max_len") or cfg.n_ctx)
+        if verify_enabled():
+            verify_sharded_servable(
+                cfg, stages=[(lo, hi, first, last)], max_len=max_len,
+                where=f"LoadServable@{self.task_index}")
+        params = build_stage_params(stage["names"], leaves)
+        with self._lock:
+            sid = f"sv{self._servable_next}"
+            self._servable_next += 1
+        name = header.get("name") or sid
+        sv = StageServable(params, cfg, lo=lo, hi=hi, first=first,
+                           last=last, max_len=max_len,
+                           name=f"{name}@{self.task_index}")
+        self.servables[sid] = sv
+        log.info("LoadServable %s (stage): %s", sid, sv.stats())
+        return self._idem_put(header, protocol.pack(
+            {"ok": True, "servable_id": sid, **sv.stats()}))
+
     def SubmitRequest(self, request: bytes, context=None) -> bytes:
         """Enqueue one generation request. Two dedup layers: the idem
         response cache (bounded LRU) and the engine's request-id dedup —
@@ -1675,7 +1709,8 @@ class TepdistServicer:
             top_k=int(header.get("top_k", 0)),
             seed=int(header.get("seed", 0)),
             deadline_ms=header.get("deadline_ms"),
-            slo_class=str(header.get("slo_class", "default")))
+            slo_class=str(header.get("slo_class", "default")),
+            prefill_only=bool(header.get("prefill_only", False)))
         return self._idem_put(header, protocol.pack({"ok": True, **out}))
 
     def PollResult(self, request: bytes, context=None) -> bytes:
@@ -1717,6 +1752,89 @@ class TepdistServicer:
         return self._idem_put(header, protocol.pack(
             {"ok": True, "handed_off": handed}))
 
+    # -- disaggregated serving (tepdist_tpu/serving/fleet.py) -----------
+    def ExportPages(self, request: bytes, context=None) -> bytes:
+        """Prefill side of the paged KV handoff. Gather mode is a pure
+        read riding the Frames zero-copy path (``want`` selects live-
+        page ordinals so prefix-hit pages the adopter already holds are
+        never shipped; ``wire_dtype`` applies comm_dtype compression);
+        ``release`` flips the parked request to "handed_off" and frees
+        its pages — state-idempotent, so no token (a replayed release
+        answers True again)."""
+        header, _ = protocol.unpack(request)
+        self._inject_server_fault("ExportPages")
+        eng = self._servable(header["servable_id"])
+        rid = header["request_id"]
+        if header.get("release"):
+            ok = eng.complete_handoff(rid)
+            return protocol.pack({"ok": True, "released": bool(ok)})
+        out = eng.export_pages(rid, want=header.get("want"))
+        if out is None:
+            return protocol.pack({"found": False})
+        wire = header.get("wire_dtype")
+        k_meta, k_blob = protocol.encode_literal(out["k"],
+                                                 wire_dtype=wire)
+        v_meta, v_blob = protocol.encode_literal(out["v"],
+                                                 wire_dtype=wire)
+        return protocol.pack_frames(
+            {"found": True, "first_token": int(out["first_token"]),
+             "pos": int(out["pos"]), "n_live": int(out["n_live"]),
+             "idx": list(out["idx"]), "k": k_meta, "v": v_meta},
+            [k_blob, v_blob])
+
+    def AdoptPages(self, request: bytes, context=None) -> bytes:
+        """Decode side of the paged KV handoff: pull the request's live
+        KV pages from the prefill replica (nested ExportPages through
+        the cached peer client), install them into the local PagePool,
+        and resume decode from the prefill-picked first token. Mutating
+        — idem-token deduped like AdoptShard, and the engine's rid
+        dedup is the second layer, so a replay past the cache still
+        cannot adopt twice. Injection BEFORE any effect: a post-install
+        fault would only exercise the retry + dedup cache, never an
+        interrupted adoption."""
+        header, blobs = protocol.unpack(request)
+        cached = self._idem_get(header)
+        if cached is not None:
+            return cached
+        self._inject_server_fault("AdoptPages")
+        eng = self._servable(header["servable_id"])
+        prompt = protocol.decode_literal(header["prompt"], blobs[0])
+        src = self._migration_peer(header["source_addr"])
+        src_sid = header["source_sid"]
+        rid = header["request_id"]
+        wire = header.get("wire_dtype")
+
+        def fetch(want):
+            return src.export_pages(src_sid, rid, want=want,
+                                    wire_dtype=wire)
+
+        out = eng.adopt_pages(
+            rid, prompt, fetch=fetch,
+            max_new_tokens=int(header["max_new_tokens"]),
+            greedy=bool(header.get("greedy", True)),
+            temperature=float(header.get("temperature", 1.0)),
+            top_k=int(header.get("top_k", 0)),
+            seed=int(header.get("seed", 0)),
+            deadline_ms=header.get("deadline_ms"),
+            slo_class=str(header.get("slo_class", "default")))
+        return self._idem_put(header,
+                              protocol.pack({"ok": True, **out}))
+
+    def ExecuteServableSlice(self, request: bytes, context=None
+                             ) -> bytes:
+        """Run one op of a pipeline-STAGE servable (fleet.py
+        StageServable): tokens into the first stage, hidden activations
+        into later ones. Exact ``cfg.dtype`` activation bytes ride back
+        on the Frames path — the sharded bit-identity contract."""
+        header, blobs = protocol.unpack(request)
+        self._inject_server_fault("ExecuteServableSlice")
+        sv = self._servable(header["servable_id"])
+        arr = protocol.decode_literal(header["array"], blobs[0])
+        out = sv.execute(str(header["op"]), arr,
+                         pos=int(header.get("pos", 0)))
+        meta, blob = protocol.encode_literal(np.asarray(out))
+        return protocol.pack_frames({"ok": True, "out": meta}, [blob])
+
     def close_servables(self) -> None:
         """Stop every serving engine (test teardown / server shutdown) —
         drain-by-default: admission stops and resident slots finish
@@ -1733,6 +1851,9 @@ class TepdistServicer:
 HEAVY_VERBS = frozenset({
     "ExecuteStepSlice", "ExecuteRemotePlan", "ExecutePlan",
     "BuildExecutionPlan", "LoadServable",
+    # Stage execute compiles on first call per shape — gate it with the
+    # other compute verbs so control RPCs never queue behind a trace.
+    "ExecuteServableSlice",
 })
 
 

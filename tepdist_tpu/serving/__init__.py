@@ -23,6 +23,11 @@ Layers (bottom-up):
     CancelRequest / Drain over any TepdistClient transport (inproc or
     gRPC), with round-robin placement, a per-replica circuit breaker,
     and failover past open/overloaded/draining replicas.
+  * fleet.py   — the disaggregated fleet: planner-sharded servables
+    (models too big for one device's HBM load as pipeline stages priced
+    by parallel/exploration.py, bit-identical to single-device
+    sample()) and FleetRouter's prefill/decode pools with page-table-
+    aware KV handoff over ExportPages/AdoptPages.
 """
 
 from tepdist_tpu.serving.kv_cache import (KVFreeError, ServableModel,
@@ -34,11 +39,16 @@ from tepdist_tpu.serving.paged_kv import (PageError, PagePool, PageTable,
 from tepdist_tpu.serving.engine import ServeRequest, ServingEngine, TERMINAL
 from tepdist_tpu.serving.supervisor import ServingSupervisor
 from tepdist_tpu.serving.client import ServeClient, ServeOverloadError
+from tepdist_tpu.serving.fleet import (FleetRouter, ShardedServable,
+                                       StageServable, load_fleet_servable,
+                                       load_sharded)
 
 __all__ = [
     "ServableModel", "SlotPool", "KVFreeError", "bucket_for",
     "default_buckets", "PageError", "PagePool", "PageTable",
     "PagedServableModel", "PrefixCache", "derive_n_pages", "pages_for",
     "ServeRequest", "ServingEngine", "TERMINAL", "ServingSupervisor",
-    "ServeClient", "ServeOverloadError",
+    "ServeClient", "ServeOverloadError", "FleetRouter",
+    "ShardedServable", "StageServable", "load_fleet_servable",
+    "load_sharded",
 ]

@@ -92,7 +92,9 @@ class ServeClient:
     def __init__(self, addresses: Optional[Sequence[str]] = None,
                  clients: Optional[Sequence[TepdistClient]] = None,
                  breaker_threshold: int = 3,
-                 breaker_cooldown_s: float = 1.0):
+                 breaker_cooldown_s: float = 1.0,
+                 prefix_affinity: bool = False,
+                 page_size: int = 16):
         if clients is not None:
             self.clients = list(clients)
             self._own_clients = False
@@ -110,6 +112,13 @@ class ServeClient:
         self._breaker_cooldown_s = breaker_cooldown_s
         self.breakers: List[_Breaker] = []
         self._drained: set = set()        # replica indices taken out
+        # Opt-in PREFIX-AFFINE routing (off by default: tests and
+        # existing callers depend on pure round-robin): repeat prompts
+        # sharing a first page_size-token chunk land on the replica
+        # whose PrefixCache already holds those pages.
+        self.prefix_affinity = bool(prefix_affinity)
+        self.page_size = int(page_size)
+        self._affinity: Dict[bytes, int] = {}
 
     # -- lifecycle ------------------------------------------------------
     def load(self, params, cfg: GPT2Config, *, slots: int = 4,
@@ -147,6 +156,18 @@ class ServeClient:
         metrics().gauge("serve_breaker_open").set(
             sum(1 for b in self.breakers if b.state == "open"))
 
+    def _affinity_key(self, prompt) -> Optional[bytes]:
+        """PrefixCache's chunk-0 chain key: blake2b over the first
+        ``page_size`` prompt tokens (paged_kv.PrefixCache._keys with an
+        empty chain seed). None for prompts shorter than one page."""
+        import hashlib
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        if p.size < self.page_size:
+            return None
+        chunk = np.ascontiguousarray(p[:self.page_size], np.int32)
+        return hashlib.blake2b(chunk.tobytes(),
+                               digest_size=16).digest()
+
     def submit(self, prompt, *, max_new_tokens: int,
                request_id: Optional[str] = None, greedy: bool = True,
                temperature: float = 1.0, top_k: int = 0, seed: int = 0,
@@ -163,9 +184,16 @@ class ServeClient:
                       prompt_len=int(np.asarray(prompt).size),
                       max_new_tokens=int(max_new_tokens))
         n = len(self._placements)
+        key = self._affinity_key(prompt) if self.prefix_affinity else None
+        if key is not None and key in self._affinity:
+            a = self._affinity[key]
+            metrics().counter("prefix_affinity_hits").inc()
+            flight.record(rid, "affinity_hit", replica=a)
+            order = [a] + [i for i in range(n) if i != a]
+        else:
+            order = [next(self._rr) % n for _ in range(n)]
         last: Any = None
-        for _ in range(n):
-            i = next(self._rr) % n
+        for i in order:
             if i in self._drained:
                 continue
             br = self.breakers[i]
@@ -196,6 +224,8 @@ class ServeClient:
                 continue
             br.record_success()
             self._update_breaker_gauge()
+            if key is not None:
+                self._affinity[key] = i
             self._where[rid] = (c, sid)
             out["request_id"] = rid
             flight.record(rid, "placed", replica=i,

@@ -70,16 +70,20 @@ from tepdist_tpu.models.gpt2 import GPT2Config
 from tepdist_tpu.models.sampling import _split_data
 from tepdist_tpu.runtime import faults
 from tepdist_tpu.serving.kv_cache import ServableModel
-from tepdist_tpu.serving.paged_kv import PagedServableModel
+from tepdist_tpu.serving.paged_kv import (PagedServableModel, PageTable,
+                                          pages_for)
 from tepdist_tpu.telemetry import flight, metrics, span
 
 log = logging.getLogger("tepdist.serving")
 
 # Terminal request states (poll stops waiting on these). "drained" =
 # handed back un-started by drain() for resubmission elsewhere; "shed" =
-# refused by the supervisor's overload watermark (supervisor.py).
+# refused by the supervisor's overload watermark (supervisor.py);
+# "handed_off" = a prefill-pool request whose KV pages were adopted by a
+# decode replica (serving/fleet.py) — terminal HERE, decode finishes it
+# THERE under the same request id.
 TERMINAL = ("done", "rejected", "expired", "cancelled", "failed",
-            "drained", "shed")
+            "drained", "shed", "handed_off")
 
 
 @dataclasses.dataclass
@@ -110,6 +114,8 @@ class ServeRequest:
     prefilled: int = 0               # prompt tokens whose k/v are cached
     prefix_tokens: int = 0           # of those, tokens from a prefix hit
     chunks: int = 0                  # prefill chunk executions
+    prefill_only: bool = False       # disagg: park at "prefilled", never
+                                     # decode (fleet.py hands the KV off)
 
     def result(self) -> Dict[str, Any]:
         out = {
@@ -196,14 +202,18 @@ class ServingEngine:
                greedy: bool = True, temperature: float = 1.0,
                top_k: int = 0, seed: int = 0,
                deadline_ms: Optional[float] = None,
-               slo_class: str = "default") -> Dict[str, Any]:
+               slo_class: str = "default",
+               prefill_only: bool = False) -> Dict[str, Any]:
         """Admission control happens here (bounded queue, validation,
         duplicate dedup); deadline expiry happens at slot-assignment
         time. Returns {"status": queued|rejected|duplicate, ...}.
         ``slo_class`` tags the request's latency/error metrics with a
         per-class suffix (``serve_ttft_ms:<class>`` …) so slo.toml
         targets can hold interactive traffic to a tighter tail than
-        batch traffic (telemetry/watchtower.py)."""
+        batch traffic (telemetry/watchtower.py). ``prefill_only`` parks
+        the request at state "prefilled" after its last chunk (KV
+        resident, first token picked, NO decode) for a disaggregated
+        handoff to a decode replica (serving/fleet.py)."""
         m = metrics()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         now = time.monotonic()
@@ -238,6 +248,8 @@ class ServingEngine:
                 err = (f"prompt+max_new_tokens "
                        f"{prompt.size + max_new_tokens} > "
                        f"max_len={self.model.max_len}")
+            elif prefill_only and self.kv_mode != "paged":
+                err = "prefill_only requires kv_mode='paged'"
             elif len(self._queue) >= self.max_queue:
                 err = f"queue full ({self.max_queue})"
             r = ServeRequest(
@@ -246,7 +258,8 @@ class ServingEngine:
                 top_k=int(top_k), seed=int(seed), deadline_ms=deadline_ms,
                 slo_class=str(slo_class), t_submit=now,
                 t_deadline=(now + deadline_ms / 1e3
-                            if deadline_ms is not None else None))
+                            if deadline_ms is not None else None),
+                prefill_only=bool(prefill_only))
             self._reqs[rid] = r
             if err is not None:
                 r.state = "rejected"
@@ -291,6 +304,12 @@ class ServingEngine:
             r = self._reqs.get(rid)
             if r is None or r.state in TERMINAL:
                 return False
+            if r.state == "adopting":
+                # The adopt thread is scattering into this table's pages
+                # outside the lock; yanking them now could hand the pages
+                # to another request mid-write. The adopter resolves the
+                # state (active/failed) within its RPC deadline.
+                return False
             self._release_locked(r)
             r.state = "cancelled"
             r.t_done = time.monotonic()
@@ -324,7 +343,13 @@ class ServingEngine:
 
     # -- scheduler ------------------------------------------------------
     def _has_work(self) -> bool:
-        return bool(self._queue) or bool(self._active)
+        if self._queue:
+            return True
+        # "prefilled"/"adopting" residents are parked on KV-handoff RPCs
+        # (fleet.py) — not schedulable work; counting them would busy-spin
+        # the scheduler thread until the handoff lands.
+        return any(r.state in ("prefill", "active")
+                   for r in self._active.values())
 
     def queue_depth(self) -> int:
         with self._cv:
@@ -518,12 +543,14 @@ class ServingEngine:
             if end < T:
                 return
             # Prompt fully resident: publish its full pages for prefix
-            # sharing, emit the first token, and join the decode batch.
+            # sharing, emit the first token, and join the decode batch —
+            # or, for a disagg prefill-pool request, park at "prefilled"
+            # with the KV held for the decode replica's AdoptPages pull.
             self.model.commit_prefix(r.prompt, r.table)
             r.t_first = time.monotonic()
             r.tokens.append(tok)
             r.pos = T
-            r.state = "active"
+            r.state = "prefilled" if r.prefill_only else "active"
             flight.record(r.rid, "first_token", gen=self.gen,
                           chunks=r.chunks)
             m.counter("serve_prefills").inc()
@@ -535,7 +562,10 @@ class ServingEngine:
             if r.ttft_span is not None:
                 r.ttft_span.__exit__(None, None, None)
                 r.ttft_span = None
-            if len(r.tokens) >= r.max_new_tokens:
+            if r.prefill_only:
+                flight.record(r.rid, "prefilled", gen=self.gen,
+                              pages=len(r.table.pages))
+            elif len(r.tokens) >= r.max_new_tokens:
                 self._finish_locked(r)
             self._cv.notify_all()
 
@@ -711,6 +741,7 @@ class ServingEngine:
                 "top_k": r.top_k,
                 "seed": r.seed,
                 "deadline_ms": r.deadline_ms,
+                "prefill_only": r.prefill_only,
             })
             flight.record(r.rid, "drain_handoff", gen=self.gen)
             m.counter("drain_handoffs").inc()
@@ -727,10 +758,14 @@ class ServingEngine:
             # yet (its first token appears only when the last chunk
             # lands), so it is still a clean resubmittable spec — hand it
             # back rather than burning drain budget finishing its prefill
-            # plus a full decode.
+            # plus a full decode. A parked "prefilled" disagg request is
+            # equally resubmittable (its single picked token regenerates
+            # deterministically from the same seed), so it hands back too
+            # instead of holding pages hostage waiting for an adopter.
             for r in [q for q in self._active.values()
-                      if q.state == "prefill"]:
+                      if q.state in ("prefill", "prefilled")]:
                 self._release_locked(r)
+                r.tokens = []
                 _hand_back(r)
             m.gauge("serve_queue_depth").set(0)
             self._cv.notify_all()
@@ -743,6 +778,199 @@ class ServingEngine:
             if not self._active and self.kv_mode == "paged":
                 self._clear_prefix_locked()
         return handed
+
+    # -- disaggregated prefill/decode handoff (serving/fleet.py) --------
+    def export_pages(self, rid: str,
+                     want: Optional[Sequence[int]] = None
+                     ) -> Optional[Dict[str, Any]]:
+        """Gather a parked ("prefilled") request's live KV pages for the
+        decode replica. ``want`` selects live-page ORDINALS (0-based
+        within the request's table) so the adopter's prefix-cache hits
+        are never re-shipped. Live pages = ``pages_for(len(prompt))``:
+        prefill wrote k/v for exactly the prompt tokens (the first
+        generated token's k/v lands at the adopter's first decode step).
+        Pure read — returns None when ``rid`` is not exportable."""
+        with self._cv:
+            r = self._reqs.get(rid)
+            if (r is None or r.state != "prefilled"
+                    or r.table is None):
+                return None
+            T = int(r.prompt.size)
+            n_live = pages_for(T, self.model.page_size)
+            live = list(r.table.pages[:n_live])
+            idx = list(want) if want is not None else list(range(n_live))
+            sel = [live[i] for i in idx]
+            first_token = int(r.tokens[0])
+            pos = int(r.pos)
+        k, v = self.model.export_pages(sel)
+        with self._cv:
+            # The gather ran outside the lock; a cancel/fail in between
+            # could have released (and recycled) the pages — re-validate
+            # before vouching for the bytes.
+            r = self._reqs.get(rid)
+            if (r is None or r.state != "prefilled" or r.table is None
+                    or list(r.table.pages[:n_live]) != live):
+                return None
+        metrics().counter("kv_pages_exported").inc(len(sel))
+        flight.record(rid, "kv_export", gen=self.gen, pages=len(sel),
+                      bytes=int(k.nbytes + v.nbytes))
+        return {"first_token": first_token, "pos": pos,
+                "n_live": n_live, "idx": idx, "k": k, "v": v}
+
+    def complete_handoff(self, rid: str) -> bool:
+        """Release a parked request's pages after a decode replica
+        adopted them: "prefilled" -> terminal "handed_off". Idempotent by
+        state machine — a replayed release finds "handed_off" and simply
+        confirms it."""
+        with self._cv:
+            r = self._reqs.get(rid)
+            if r is None:
+                return False
+            if r.state == "handed_off":
+                return True
+            if r.state != "prefilled":
+                return False
+            self._release_locked(r)
+            r.state = "handed_off"
+            r.t_done = time.monotonic()
+            flight.record(rid, "pool_handoff", gen=self.gen,
+                          n_tokens=len(r.tokens))
+            metrics().counter("pool_handoffs").inc()
+            if (self._draining and not self._active
+                    and self.kv_mode == "paged"):
+                self._clear_prefix_locked()
+            self._cv.notify_all()
+            return True
+
+    def adopt_pages(self, rid: str, prompt, *, max_new_tokens: int,
+                    fetch: Callable[[Sequence[int]],
+                                    Optional[Dict[str, Any]]],
+                    greedy: bool = True, temperature: float = 1.0,
+                    top_k: int = 0, seed: int = 0,
+                    deadline_ms: Optional[float] = None,
+                    slo_class: str = "default") -> Dict[str, Any]:
+        """Decode-side adoption: allocate local pages for the request,
+        pull the KV contents the prefix cache does NOT already cover via
+        ``fetch(want_ordinals)`` (an ExportPages RPC to the prefill
+        replica), install them, and enter the decode batch at
+        ``pos=len(prompt)`` with the prefill's first token. Page-table-
+        aware: only live pages move, prefix-hit pages are never
+        re-shipped (``kv_pages_reused``). Deduped by rid exactly like
+        ``submit`` — a replayed adoption never double-installs."""
+        m = metrics()
+        if self.kv_mode != "paged":
+            return {"status": "rejected",
+                    "error": "adopt_pages requires kv_mode='paged'"}
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        T = int(prompt.size)
+        now = time.monotonic()
+        model = self.model
+        ps = model.page_size
+        with self._cv:
+            if rid in self._reqs:
+                m.counter("serve_requests_deduped").inc()
+                flight.record(rid, "dedup", gen=self.gen)
+                return {"status": "duplicate",
+                        "state": self._reqs[rid].state}
+            if self._dead:
+                flight.record(rid, "reject", gen=self.gen, reason="dead")
+                return {"status": "rejected",
+                        "error": f"engine dead: {self._error}"}
+            if self._draining:
+                flight.record(rid, "draining", gen=self.gen)
+                return {"status": "draining"}
+            if (T == 0 or max_new_tokens < 1
+                    or T + max_new_tokens > model.max_len):
+                return {"status": "rejected",
+                        "error": f"invalid adoption spec (prompt {T}, "
+                                 f"max_new {max_new_tokens}, "
+                                 f"max_len {model.max_len})"}
+            n_live = pages_for(T, ps)
+            total = model.request_pages(T, max_new_tokens)
+            # Local prefix hits substitute for shipped pages: decode
+            # already holds their contents, so they drop out of `want`.
+            hit = (model.prefix.lookup(prompt)
+                   if model.prefix is not None else [])
+            shared = list(hit[:n_live])
+            for p in shared:
+                model.pool.incref(p)
+            fresh = total - len(shared)
+            avail = model.pool.available
+            if avail < fresh and model.prefix is not None:
+                model.prefix.evict(fresh - avail)
+            if not model.pool.reserve(fresh):
+                for p in shared:
+                    model.pool.decref(p)
+                model._update_gauges()
+                return {"status": "rejected",
+                        "error": f"page pool exhausted (need {fresh})"}
+            fresh_now = n_live - len(shared)
+            new_pages = (model.pool.alloc(fresh_now, reserved=True)
+                         if fresh_now else [])
+            table = PageTable(pages=shared + new_pages,
+                              n_shared=len(shared),
+                              reserved=total - n_live)
+            r = ServeRequest(
+                rid=rid, prompt=prompt,
+                max_new_tokens=int(max_new_tokens), greedy=bool(greedy),
+                temperature=float(temperature), top_k=int(top_k),
+                seed=int(seed), deadline_ms=deadline_ms,
+                slo_class=str(slo_class), t_submit=now, state="adopting",
+                table=table,
+                t_deadline=(now + deadline_ms / 1e3
+                            if deadline_ms is not None else None))
+            # Registered while still mid-pull so a replayed AdoptPages
+            # dedups instead of double-allocating.
+            self._reqs[rid] = r
+            model._update_gauges()
+        try:
+            want = list(range(len(shared), n_live))
+            export = fetch(want)
+            if export is None:
+                raise RuntimeError(
+                    f"source could not export pages for {rid}")
+            if fresh_now:
+                model.adopt_pages_into(new_pages, export["k"],
+                                       export["v"])
+            tok0 = int(export["first_token"])
+            moved = int(np.asarray(export["k"]).nbytes
+                        + np.asarray(export["v"]).nbytes)
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller
+            with self._cv:
+                model.release_table(table)
+                # Drop the record entirely: the router retries on another
+                # decode replica under the SAME rid, which must not dedup
+                # against this failed attempt.
+                self._reqs.pop(rid, None)
+                self._cv.notify_all()
+            flight.record(rid, "kv_adopt_fail", gen=self.gen,
+                          reason=repr(e))
+            raise
+        with self._cv:
+            r.tokens = [tok0]
+            r.pos = T
+            r.prefilled = T
+            r.prefix_tokens = len(shared) * ps
+            r.t_first = time.monotonic()
+            if not r.greedy:
+                # Reconstruct the sampling RNG exactly where the prefill
+                # replica left it: one split consumed picking tok0.
+                kd = jax.random.key_data(jax.random.PRNGKey(r.seed))
+                r.kd, _ = _split_data(kd)
+            r.state = "active"
+            self._active[rid] = r
+            model.commit_prefix(prompt, table)
+            m.counter("kv_pages_adopted").inc(fresh_now)
+            m.counter("kv_pages_reused").inc(len(shared))
+            flight.record(rid, "kv_adopt", gen=self.gen,
+                          pages=fresh_now, reused=len(shared),
+                          bytes=moved, pos=T)
+            m.gauge("serve_slot_occupancy").set(len(self._active))
+            if len(r.tokens) >= r.max_new_tokens:
+                self._finish_locked(r)
+            self._cv.notify_all()
+        return {"status": "adopted", "pages": fresh_now,
+                "reused": len(shared)}
 
     def run_until_idle(self, max_steps: int = 100000) -> None:
         """Drive the scheduler synchronously (lockstep tests/benches;

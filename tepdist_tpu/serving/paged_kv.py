@@ -459,6 +459,13 @@ def _copy_page_impl(ck, cv, src, dst):
             cv.at[:, dst].set(cv[:, src]))
 
 
+def _adopt_pages_impl(ck, cv, k, v, page_ids):
+    """Disaggregated handoff: scatter shipped page contents ``k``/``v``
+    ([n_layer, n, H, ps, hd]) into local physical pages ``page_ids``."""
+    return (ck.at[:, page_ids].set(k.astype(ck.dtype)),
+            cv.at[:, page_ids].set(v.astype(cv.dtype)))
+
+
 class PagedServableModel:
     """A loaded model + its page pool, prefix cache, and compiled
     page-indexed serving executables (the paged twin of ServableModel).
@@ -511,6 +518,7 @@ class PagedServableModel:
         self._decode_exe: Dict[Tuple[int, int], Any] = {}
         self._pick_exe: Dict[Tuple[bool, int], Any] = {}
         self._copy_exe = None
+        self._adopt_exe: Dict[int, Any] = {}
         self._update_gauges()
 
     # -- executable cache ----------------------------------------------
@@ -527,6 +535,7 @@ class PagedServableModel:
         self._decode_exe = dict(other._decode_exe)
         self._pick_exe = dict(other._pick_exe)
         self._copy_exe = other._copy_exe
+        self._adopt_exe = dict(other._adopt_exe)
 
     def _compiled(self, cache, key, build):
         fn = cache.get(key)
@@ -651,6 +660,26 @@ class PagedServableModel:
             self.pool.unreserve(table.reserved)
             table.reserved = 0
         self._update_gauges()
+
+    # -- disaggregated handoff (ISSUE 19) --------------------------------
+    def export_pages(self, page_ids: Sequence[int]
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """Gather the CONTENTS of physical pages ``page_ids`` for the
+        prefill->decode wire: k/v [n_layer, len(ids), H, ps, hd]. A pure
+        device read — no allocator state touched."""
+        idx = jnp.asarray(list(page_ids), jnp.int32)
+        return (np.asarray(self.ck[:, idx]), np.asarray(self.cv[:, idx]))
+
+    def adopt_pages_into(self, page_ids: Sequence[int], k, v) -> None:
+        """Scatter shipped page contents into local physical pages
+        ``page_ids`` (already alloc'd by the caller). Compiled per page
+        count, like the other page-indexed executables."""
+        n = len(page_ids)
+        fn = self._compiled(self._adopt_exe, n,
+                            lambda: jax.jit(_adopt_pages_impl))
+        self.ck, self.cv = fn(self.ck, self.cv, jnp.asarray(k),
+                              jnp.asarray(v),
+                              jnp.asarray(list(page_ids), jnp.int32))
 
     # -- executables (no host allocator state; run outside the lock) ----
     def prefill_chunk(self, pages: Sequence[int], prompt: np.ndarray,

@@ -80,6 +80,7 @@ class _JournalEntry:
     slo_class: str = "default"
     prefix: List[int] = dataclasses.field(default_factory=list)
     replays: int = 0
+    prefill_only: bool = False
 
 
 class ServingSupervisor:
@@ -217,7 +218,8 @@ class ServingSupervisor:
                     top_k=int(kwargs.get("top_k", 0)),
                     seed=int(kwargs.get("seed", 0)),
                     deadline_ms=kwargs.get("deadline_ms"),
-                    slo_class=str(kwargs.get("slo_class", "default")))
+                    slo_class=str(kwargs.get("slo_class", "default")),
+                    prefill_only=bool(kwargs.get("prefill_only", False)))
             return out
 
     def cancel(self, rid: str) -> bool:
@@ -290,6 +292,56 @@ class ServingSupervisor:
             eng = self.engine
         return eng.drain(wait_ms=wait_ms)
 
+    # -- disaggregated handoff (serving/fleet.py) ------------------------
+    def export_pages(self, rid: str, want=None):
+        with self._lock:
+            eng = self.engine
+        return eng.export_pages(rid, want)
+
+    def complete_handoff(self, rid: str) -> bool:
+        with self._lock:
+            eng = self.engine
+        return eng.complete_handoff(rid)
+
+    def adopt_pages(self, rid: str, prompt, *, fetch,
+                    **kwargs) -> Dict[str, Any]:
+        """Journal-aware adoption: the entry is registered up front so a
+        decode-engine crash after adoption replays the request as a
+        PLAIN submit (full local prefill) on the rebuilt engine — the
+        handoff pages died with the corpse, the prompt did not. The
+        nested fetch runs OUTSIDE the supervisor lock (it is a network
+        pull; poll/submit must not stall behind it)."""
+        with self._lock:
+            eng = self.engine
+            if rid in self._completed:
+                metrics().counter("serve_requests_deduped").inc()
+                return {"status": "duplicate",
+                        "state": self._completed[rid]["status"]}
+            fresh_entry = rid not in self._journal
+            if fresh_entry:
+                self._journal[rid] = _JournalEntry(
+                    rid=rid,
+                    prompt=np.asarray(prompt, np.int32).reshape(-1),
+                    max_new_tokens=int(kwargs["max_new_tokens"]),
+                    greedy=bool(kwargs.get("greedy", True)),
+                    temperature=float(kwargs.get("temperature", 1.0)),
+                    top_k=int(kwargs.get("top_k", 0)),
+                    seed=int(kwargs.get("seed", 0)),
+                    deadline_ms=kwargs.get("deadline_ms"),
+                    slo_class=str(kwargs.get("slo_class", "default")))
+        try:
+            out = eng.adopt_pages(rid, prompt, fetch=fetch, **kwargs)
+        except Exception:
+            if fresh_entry:
+                with self._lock:
+                    self._journal.pop(rid, None)
+            raise
+        if fresh_entry and out.get("status") not in ("adopted",
+                                                     "duplicate"):
+            with self._lock:
+                self._journal.pop(rid, None)
+        return out
+
     # -- recovery -------------------------------------------------------
     def _on_engine_fault(self, exc: BaseException) -> None:
         """Engine fault callback — runs on the DYING engine's scheduler
@@ -340,11 +392,16 @@ class ServingSupervisor:
                     continue
                 if e is None:      # pragma: no cover — journal invariant
                     continue
-                if e.greedy:
+                if e.greedy and not e.prefill_only:
                     # Accumulate across generations: a request may
                     # survive several crashes.
                     e.prefix = list(e.prefix) + list(r.tokens)
                 else:
+                    # Non-greedy regenerates from the seed; a prefill-only
+                    # request must replay its WHOLE prompt — a prefix
+                    # would shift the handoff position the decode replica
+                    # adopts at (the single picked token re-picks
+                    # deterministically from the same seed anyway).
                     e.prefix = []
                 replay.append(e)
             # Replays bypass the queue bound: every one of them was
@@ -360,7 +417,7 @@ class ServingSupervisor:
                     max_new_tokens=e.max_new_tokens - len(e.prefix),
                     greedy=e.greedy, temperature=e.temperature,
                     top_k=e.top_k, seed=e.seed, deadline_ms=e.deadline_ms,
-                    slo_class=e.slo_class)
+                    slo_class=e.slo_class, prefill_only=e.prefill_only)
                 e.replays += 1
                 metrics().counter("requests_replayed").inc()
                 flight.record(e.rid, "replay", gen=self.restarts,

@@ -358,3 +358,58 @@ def test_finished_results_carried_across_restart(params):
     assert out == {"status": "duplicate", "state": "done"}
     assert _counters().get("serve_requests_deduped", 0) \
         - before.get("serve_requests_deduped", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated handoff chaos (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def test_decode_replica_death_mid_handoff_replays_exactly_once(params):
+    """A decode replica dies between submit and handoff: the router's
+    AdoptPages attempt fails over to the surviving decode replica,
+    the replay adopts exactly once (the failed attempt's rid record is
+    dropped, so the survivor is not dedup-blocked), outputs stay
+    bit-identical to sample(), and no pages leak on either live pool."""
+    from tepdist_tpu.rpc.inproc import unregister_servicer
+    from tepdist_tpu.serving import FleetRouter, pages_for
+
+    prompts, mnts = _mix(4, seed=13, lo=5, hi=20)
+    cluster, servicers = make_inproc_cluster(3, jax.devices()[:3])
+    clients = [TepdistClient(w.address) for w in cluster.workers]
+    router = FleetRouter(clients, prefill=1, decode=2)
+    before = _counters()
+    try:
+        router.load(params, CFG, max_len=64, name="ddeath")
+        rids = [router.submit(p, max_new_tokens=m)["request_id"]
+                for p, m in zip(prompts, mnts)]
+        # Kill decode replica d0 (worker 1) before any handoff: every
+        # AdoptPages aimed at it burns the retry budget, surfaces as a
+        # transport error, and fails over to d1.
+        unregister_servicer(cluster.workers[1].address)
+        for rid in rids:
+            out = router.handoff(rid, timeout_s=120)
+            assert out["status"] in ("adopted", "duplicate")
+        results = router.wait(rids, timeout_s=300)
+        # Every request landed on the survivor, exactly once.
+        assert all(results[r]["status"] == "done" for r in rids)
+        for p, m, rid in zip(prompts, mnts, rids):
+            ref = np.asarray(sample(params, p[None], CFG,
+                                    max_new_tokens=m,
+                                    greedy=True))[0, len(p):]
+            np.testing.assert_array_equal(
+                np.asarray(results[rid]["tokens"], np.int32), ref)
+        router.drain_all(wait_ms=5000.0)
+        leaked = sum(int(e.stats().get("pages_used", 0))
+                     for s in (servicers[0], servicers[2])
+                     for e in s.servables.values())
+        assert leaked == 0
+    finally:
+        faults.configure(None)
+        for s in servicers:
+            s.close_servables()
+        close_inproc_cluster(cluster)
+    d = lambda k: _counters().get(k, 0) - before.get(k, 0)  # noqa: E731
+    # Exactly-once: the survivor adopted each request's live pages once.
+    live = sum(pages_for(len(p), router.page_size) for p in prompts)
+    assert d("kv_pages_adopted") == live
+    assert d("pool_handoffs") == len(prompts)

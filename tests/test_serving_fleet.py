@@ -1,0 +1,389 @@
+"""Disaggregated serving fleet tests (serving/fleet.py).
+
+Covers the ISSUE 19 acceptance gates on the inproc transport:
+
+  * SHARDED: a servable too big for one emulated device (HBM_GB knob)
+    loads via the planner-priced pipeline split across >= 2 in-proc
+    workers, and greedy (and seeded non-greedy) outputs through the
+    chained ExecuteServableSlice path are BIT-IDENTICAL to
+    single-device ``sample()``; the fallback from a non-executable
+    global best is recorded (``serve_shard_plan_fallback``).
+  * VERIFY: ``verify_sharded_servable`` raises ``hbm_overflow`` naming
+    the offending stage and passes when every stage fits.
+  * DISAGG: prefill/decode pools hand off paged KV; greedy decode is
+    bit-identical to ``sample()`` AND to a single-pool engine; ONLY
+    live pages move (counter-verified); prefix-cache-hit pages are
+    never re-shipped; zero pages leak after draining both pools.
+  * EXACTLY-ONCE: AdoptPages under injected ``rpc_drop`` +
+    ``server_fault`` replays exactly once (idem token + engine dedup).
+  * AFFINITY: repeat prefixes pin to the prefill replica that already
+    holds their pages (``prefix_affinity_hits``), in FleetRouter and
+    the opt-in ServeClient knob.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tepdist_tpu.analysis.plan_verify import (PlanVerificationError,
+                                              verify_sharded_servable)
+from tepdist_tpu.models import gpt2
+from tepdist_tpu.models.sampling import sample
+from tepdist_tpu.rpc.client import TepdistClient
+from tepdist_tpu.rpc.inproc import (close_inproc_cluster,
+                                    make_inproc_cluster)
+from tepdist_tpu.runtime import faults
+from tepdist_tpu.serving import (FleetRouter, ServeClient,
+                                 ShardedServable, load_fleet_servable,
+                                 load_sharded, pages_for)
+from tepdist_tpu.serving.fleet import (build_stage_params, resolve_leaf,
+                                       stage_param_names, stage_ranges)
+from tepdist_tpu.telemetry import metrics
+
+pytestmark = [pytest.mark.serving]
+
+CFG = gpt2.CONFIGS["test"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2.init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    faults.configure(None)
+    yield
+    faults.reset()
+
+
+def _counters():
+    return dict(metrics().snapshot()["counters"])
+
+
+def _cluster(n):
+    cluster, servicers = make_inproc_cluster(n, jax.devices()[:n])
+    clients = [TepdistClient(w.address) for w in cluster.workers]
+    return cluster, servicers, clients
+
+
+def _teardown(cluster, servicers):
+    for s in servicers:
+        s.close_servables()
+    close_inproc_cluster(cluster)
+
+
+def _ref(params, prompt, max_new, **kw):
+    return np.asarray(sample(params, np.asarray(prompt, np.int32)[None],
+                             CFG, max_new_tokens=max_new, greedy=True,
+                             **kw))[0]
+
+
+def _leaked_pages(servicers) -> int:
+    return sum(int(e.stats().get("pages_used", 0))
+               for s in servicers for e in s.servables.values())
+
+
+# ---------------------------------------------------------------------------
+# stage partitioning units
+# ---------------------------------------------------------------------------
+
+def test_stage_ranges_and_param_names(params):
+    assert stage_ranges(2, 2) == [(0, 1), (1, 2)]
+    with pytest.raises(ValueError):
+        stage_ranges(3, 2)
+    names0 = stage_param_names(CFG, 0, 1, True, False)
+    names1 = stage_param_names(CFG, 1, 2, False, True)
+    assert names0[:2] == ["wte", "wpe"]
+    assert "h0.attn_qkv_w" in names0 and "h1.mlp_fc_w" in names1
+    # The last stage re-ships wte for the tied logits matmul + ln_f.
+    assert names1[-3:] == ["wte", "ln_f_g", "ln_f_b"]
+    # Round-trip: resolve -> rebuild reproduces the leaves exactly.
+    leaves = [np.asarray(resolve_leaf(params, n)) for n in names1]
+    rebuilt = build_stage_params(names1, leaves)
+    np.testing.assert_array_equal(np.asarray(rebuilt["h1"]["ln2_g"]),
+                                  np.asarray(params["h1"]["ln2_g"]))
+    np.testing.assert_array_equal(np.asarray(rebuilt["wte"]),
+                                  np.asarray(params["wte"]))
+
+
+def test_verify_sharded_servable_overflow_and_fit():
+    stages = [(0, 1, True, False), (1, 2, False, True)]
+    # Generous budget: returns the per-stage byte footprints.
+    out = verify_sharded_servable(CFG, stages=stages, max_len=64,
+                                  hbm_limit_bytes=1e9)
+    assert set(out) == {0, 1} and all(v > 0 for v in out.values())
+    # Starved budget: hbm_overflow naming the offending stage.
+    with pytest.raises(PlanVerificationError) as ei:
+        verify_sharded_servable(CFG, stages=stages, max_len=64,
+                                hbm_limit_bytes=1024.0)
+    assert ei.value.kind == "hbm_overflow"
+    assert "stage 0" in str(ei.value)
+    with pytest.raises(PlanVerificationError):
+        verify_sharded_servable(CFG, stages=[(0, 0, True, True)],
+                                max_len=64, hbm_limit_bytes=1e9)
+
+
+# ---------------------------------------------------------------------------
+# planner-sharded servables
+# ---------------------------------------------------------------------------
+
+def test_sharded_servable_bit_identical_to_sample(params):
+    """Tentpole (a): the planner-priced 2-stage split over 2 in-proc
+    workers generates bit-identically to single-device sample(), for
+    greedy AND seeded non-greedy decode; the cost-model fallback from
+    the non-executable spmd global best is recorded."""
+    cluster, servicers, clients = _cluster(2)
+    before = _counters()
+    try:
+        sv = load_sharded(clients, params, CFG, name="shards",
+                          max_len=64)
+        assert sv.num_stages == 2
+        rng = np.random.RandomState(1)
+        for t in (4, 17, 33):
+            p = rng.randint(1, CFG.vocab_size, size=t).astype(np.int32)
+            out = sv.generate_one(p, max_new_tokens=5, greedy=True)
+            np.testing.assert_array_equal(out, _ref(params, p, 5))
+        # Non-greedy: same RNG chain as sample(key=PRNGKey(seed)).
+        p = rng.randint(1, CFG.vocab_size, size=9).astype(np.int32)
+        out = sv.generate_one(p, max_new_tokens=4, greedy=False, seed=3)
+        ref = np.asarray(sample(params, p[None], CFG, max_new_tokens=4,
+                                greedy=False,
+                                key=jax.random.PRNGKey(3)))[0]
+        np.testing.assert_array_equal(out, ref)
+    finally:
+        _teardown(cluster, servicers)
+    d = _counters()
+    # The tiny test model's global best is spmd — not executable as a
+    # serving split — so the honest-fallback counter must tick.
+    assert sv.plan["fallback"]
+    assert (d.get("serve_shard_plan_fallback", 0)
+            - before.get("serve_shard_plan_fallback", 0)) >= 1
+
+
+def test_hbm_overflow_routes_to_sharded(params, monkeypatch):
+    """Acceptance: with the emulated per-device HBM (HBM_GB knob)
+    too small for weights+KV, load_fleet_servable routes through the
+    planner and lands a sharded servable across 2 workers, still
+    bit-identical to sample()."""
+    from tepdist_tpu.core.service_env import ServiceEnv
+    monkeypatch.setenv("HBM_GB", "0.0005")
+    ServiceEnv.reset()
+    cluster, servicers, clients = _cluster(2)
+    try:
+        sv = load_fleet_servable(clients, params, CFG, name="auto",
+                                 max_len=64)
+        assert isinstance(sv, ShardedServable)
+        p = np.arange(1, 12, dtype=np.int32)
+        out = sv.generate_one(p, max_new_tokens=4, greedy=True)
+        np.testing.assert_array_equal(out, _ref(params, p, 4))
+    finally:
+        _teardown(cluster, servicers)
+        monkeypatch.delenv("HBM_GB")
+        ServiceEnv.reset()
+
+
+def test_fits_one_device_stays_replicated(params):
+    """Without the starved-HBM override the auto path installs a plain
+    replicated ServeClient — sharding is strictly the overflow arm."""
+    cluster, servicers, clients = _cluster(2)
+    try:
+        sv = load_fleet_servable(clients, params, CFG, name="fits",
+                                 max_len=64)
+        assert isinstance(sv, ServeClient)
+        p = np.arange(2, 9, dtype=np.int32)
+        outs = sv.generate([p], max_new_tokens=4)
+        np.testing.assert_array_equal(outs[0], _ref(params, p, 4))
+    finally:
+        _teardown(cluster, servicers)
+
+
+# ---------------------------------------------------------------------------
+# prefill/decode disaggregation
+# ---------------------------------------------------------------------------
+
+def test_disagg_bit_identity_and_zero_leak(params):
+    """Tentpole (b): 1 prefill + 1 decode replica; greedy outputs are
+    bit-identical to sample() AND to a single-pool engine; only live
+    pages move; zero pages leak after draining both pools."""
+    prompts = [np.random.RandomState(s).randint(
+                   1, CFG.vocab_size, size=t).astype(np.int32)
+               for s, t in ((0, 5), (1, 17), (2, 33))]
+    cluster, servicers, clients = _cluster(3)
+    before = _counters()
+    router = FleetRouter(clients[:2], prefill=1, decode=1)
+    single = ServeClient(clients=clients[2:])
+    try:
+        router.load(params, CFG, max_len=64, name="disagg")
+        single.load(params, CFG, max_len=64, name="single",
+                    kv_mode="paged")
+        outs = router.generate(prompts, max_new_tokens=6, greedy=True)
+        ref_pool = single.generate(prompts, max_new_tokens=6)
+        for p, o, rp in zip(prompts, outs, ref_pool):
+            np.testing.assert_array_equal(o, _ref(params, p, 6))
+            np.testing.assert_array_equal(o, rp)
+        router.drain_all(wait_ms=5000.0)
+        assert _leaked_pages(servicers[:2]) == 0
+    finally:
+        _teardown(cluster, servicers)
+    d = _counters()
+
+    def delta(k):
+        return d.get(k, 0) - before.get(k, 0)
+
+    # Page-table-aware: exactly the LIVE pages moved — pages_for(T)
+    # per request, nothing for the reserved decode headroom.
+    live = sum(pages_for(len(p), router.page_size) for p in prompts)
+    assert delta("kv_pages_exported") == live
+    assert delta("kv_pages_adopted") == live
+    assert delta("pool_handoffs") == len(prompts)
+    assert len(router.handoff_ms) == len(prompts)
+    assert len(router.ttft_ms) == len(prompts)
+
+
+def test_disagg_prefix_hit_pages_never_reshipped(params):
+    """A repeat prompt whose prefix the decode replica already cached
+    adopts those pages locally — the export ships ONLY the fresh
+    tail pages (kv_pages_reused counts the rest)."""
+    p = np.random.RandomState(5).randint(
+        1, CFG.vocab_size, size=34).astype(np.int32)
+    cluster, servicers, clients = _cluster(2)
+    router = FleetRouter(clients, prefill=1, decode=1)
+    before = _counters()
+    try:
+        router.load(params, CFG, max_len=64, name="reuse")
+        first = router.generate([p], max_new_tokens=4, greedy=True)[0]
+        mid = _counters()
+        second = router.generate([p], max_new_tokens=4, greedy=True)[0]
+        np.testing.assert_array_equal(first, second)
+        np.testing.assert_array_equal(first, _ref(params, p, 4))
+        router.drain_all(wait_ms=5000.0)
+        assert _leaked_pages(servicers) == 0
+    finally:
+        _teardown(cluster, servicers)
+    d = _counters()
+    live = pages_for(len(p), router.page_size)
+    # First handoff ships all live pages; the repeat reuses the decode
+    # side's cached prefix pages and ships only what's left.
+    assert (mid.get("kv_pages_exported", 0)
+            - before.get("kv_pages_exported", 0)) == live
+    reused = d.get("kv_pages_reused", 0) - mid.get("kv_pages_reused", 0)
+    shipped = (d.get("kv_pages_exported", 0)
+               - mid.get("kv_pages_exported", 0))
+    assert reused >= 2
+    assert shipped == live - reused
+
+
+def test_adopt_pages_exactly_once_under_chaos(params):
+    """Acceptance: ExportPages/AdoptPages under injected rpc_drop
+    (pure-loss AND applied-but-unacked) + server_fault replay
+    exactly-once — bit-identical output, no double-install, zero
+    leaked pages."""
+    prompts = [np.random.RandomState(s).randint(
+                   1, CFG.vocab_size, size=t).astype(np.int32)
+               for s, t in ((3, 7), (4, 19), (5, 12), (6, 30))]
+    cluster, servicers, clients = _cluster(3)
+    router = FleetRouter(clients, prefill=1, decode=2)
+    before = _counters()
+    try:
+        router.load(params, CFG, max_len=64, name="chaos")
+        faults.configure("rpc_drop:verb=AdoptPages,p=0.5,seed=11;"
+                         "server_fault:verb=AdoptPages,p=0.3")
+        try:
+            outs = router.generate(prompts, max_new_tokens=5,
+                                   greedy=True)
+        finally:
+            faults.configure(None)
+        for p, o in zip(prompts, outs):
+            np.testing.assert_array_equal(o, _ref(params, p, 5))
+        router.drain_all(wait_ms=5000.0)
+        assert _leaked_pages(servicers) == 0
+    finally:
+        _teardown(cluster, servicers)
+    d = _counters()
+
+    def delta(k):
+        return d.get(k, 0) - before.get(k, 0)
+
+    assert delta("fault_injected:rpc_drop") \
+        + delta("fault_injected:server_fault") >= 1
+    # Exactly-once: every request adopted its live pages exactly once
+    # despite the replays (a double-install would double this count).
+    live = sum(pages_for(len(p), router.page_size) for p in prompts)
+    assert delta("kv_pages_adopted") == live
+    assert delta("rpc_retries") >= 1
+
+
+def test_prefix_affinity_routing(params):
+    """Satellite: repeat prefixes pin to the prefill replica that
+    already holds their pages — FleetRouter hashes PrefixCache's
+    chunk-0 key; prefix_affinity_hits counts the repeats."""
+    p = np.random.RandomState(9).randint(
+        1, CFG.vocab_size, size=20).astype(np.int32)
+    cluster, servicers, clients = _cluster(3)
+    router = FleetRouter(clients, prefill=2, decode=1)
+    before = _counters()
+    try:
+        router.load(params, CFG, max_len=64, name="affine")
+        outs = [router.generate([p], max_new_tokens=3, greedy=True)[0]
+                for _ in range(3)]
+        np.testing.assert_array_equal(outs[0], outs[1])
+        np.testing.assert_array_equal(outs[0], _ref(params, p, 3))
+    finally:
+        _teardown(cluster, servicers)
+    d = _counters()
+    assert (d.get("prefix_affinity_hits", 0)
+            - before.get("prefix_affinity_hits", 0)) == 2
+    # The pin means the prefill-side prefix cache actually hit.
+    assert (d.get("prefix_hits", 0) - before.get("prefix_hits", 0)) >= 1
+
+
+def test_serve_client_prefix_affinity_opt_in(params):
+    """The opt-in ServeClient knob: identical prompts land on the same
+    replica instead of round-robining."""
+    p = np.random.RandomState(8).randint(
+        1, CFG.vocab_size, size=18).astype(np.int32)
+    cluster, servicers, clients = _cluster(2)
+    sc = ServeClient(clients=clients, prefix_affinity=True)
+    try:
+        sc.load(params, CFG, max_len=64, name="affine-sc")
+        rids = [sc.submit(p, max_new_tokens=2)["request_id"]
+                for _ in range(3)]
+        placements = {sc._where[r][0].stub.address for r in rids}
+        assert len(placements) == 1
+        sc.wait(rids, timeout_s=120)
+    finally:
+        _teardown(cluster, servicers)
+
+
+def test_export_release_idempotent_and_dedup(params):
+    """The handoff verbs' replay story: a repeated release answers
+    True again (state-idempotent), and a replayed AdoptPages is
+    answered as a duplicate without re-pulling pages."""
+    p = np.arange(1, 20, dtype=np.int32)
+    cluster, servicers, clients = _cluster(2)
+    router = FleetRouter(clients, prefill=1, decode=1)
+    try:
+        router.load(params, CFG, max_len=64, name="idem")
+        out = router.submit(p, max_new_tokens=3, greedy=True)
+        rid = out["request_id"]
+        router.handoff(rid, timeout_s=60)
+        pc, psid = router._prefill[0]
+        dc, dsid = router._decode[0]
+        # Release replay: the request is already "handed_off".
+        assert pc.export_pages(psid, rid, release=True)["released"]
+        # Adopt replay (fresh idem token, same rid): engine rid-dedup.
+        before = _counters()
+        dup = dc.adopt_pages(dsid, rid, p,
+                             source_addr=pc.stub.address,
+                             source_sid=psid, max_new_tokens=3)
+        assert dup["status"] == "duplicate"
+        d = _counters()
+        assert (d.get("kv_pages_adopted", 0)
+                - before.get("kv_pages_adopted", 0)) == 0
+        res = router.wait([rid], timeout_s=120)[rid]
+        np.testing.assert_array_equal(
+            np.concatenate([p, np.asarray(res["tokens"], np.int32)]),
+            _ref(params, p, 3))
+    finally:
+        _teardown(cluster, servicers)

@@ -68,7 +68,13 @@ DEFAULT_KEYS = ("two_worker_fleet_ms", "two_worker_fleet_compressed_ms",
                 # resume, budgeted at one step wall + shard-move time
                 # (scripts/elastic_smoke.sh records it from the chaos
                 # arm's kill-worker run).
-                "migration_stall_ms")
+                "migration_stall_ms",
+                # ISSUE 19: disaggregated prefill/decode serving —
+                # submit -> decoding TTFT through the split pools and
+                # the prefilled -> decoding KV-page handoff itself
+                # (scripts/disagg_smoke.sh records both from
+                # serve_load --disagg).
+                "disagg_ttft_ms", "kv_handoff_ms")
 
 # Per-key relative noise-band floors overriding the global --band-pct
 # when larger.  The overhead percentages are ratios of two noisy
@@ -83,7 +89,12 @@ BAND_FLOOR_PCT = {"ledger_overhead_pct": 0.15, "flight_overhead_pct": 0.15,
                   # scheduling + checkpoint IO + RPC fan-out; local runs
                   # jitter well past the default band.  25% still trips
                   # the elastic smoke's seeded 50% regression.
-                  "migration_stall_ms": 0.25}
+                  "migration_stall_ms": 0.25,
+                  # Disagg handoff/TTFT are small wall times over poll
+                  # loops + nested RPC pulls; 20% absorbs scheduler
+                  # jitter yet still trips the disagg smoke's seeded
+                  # 30% regression on kv_handoff_ms.
+                  "disagg_ttft_ms": 0.2, "kv_handoff_ms": 0.2}
 
 _HIGHER_BETTER_SUFFIXES = ("tok_s", "_x", "_per_s", "_rate", "_speedup")
 _PROMOTE_SUFFIXES = ("_ms", "_us", "_x", "_pct", "tok_s", "_per_s",
@@ -130,6 +141,11 @@ def serve_json_values(summary: Dict[str, Any]) -> Dict[str, float]:
         v = _numeric(ttft.get(pct))
         if v is not None:
             out[f"serving_ttft_ms_{pct}"] = v
+    # Disaggregated runs (serve_load --disagg) carry the handoff lines.
+    for key in ("disagg_ttft_ms", "kv_handoff_ms"):
+        v = _numeric(summary.get(key))
+        if v is not None:
+            out[key] = v
     return out
 
 

@@ -16,10 +16,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run_load(config: str = "test", workers: int = 2, slots: int = 4,
@@ -34,7 +38,8 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
              hbm_budget_bytes: Optional[float] = None,
              prefill_chunk: Optional[int] = None,
              shared_prefix: int = 0,
-             long_prompt: int = 0) -> Dict[str, Any]:
+             long_prompt: int = 0,
+             disagg: Optional[str] = None) -> Dict[str, Any]:
     import jax
 
     from tepdist_tpu import telemetry
@@ -43,7 +48,18 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
     from tepdist_tpu.rpc.inproc import (close_inproc_cluster,
                                         make_inproc_cluster)
     from tepdist_tpu.runtime import faults
-    from tepdist_tpu.serving import ServeClient
+    from tepdist_tpu.serving import FleetRouter, ServeClient
+
+    # --disagg P:D — route through the prefill/decode FleetRouter
+    # (serving/fleet.py) instead of the round-robin ServeClient.
+    pools = None
+    if disagg:
+        p_n, d_n = (int(x) for x in disagg.split(":"))
+        if kv_mode != "paged":
+            raise ValueError("--disagg needs kv_mode='paged' "
+                             "(the handoff moves KV pages)")
+        pools = (p_n, d_n)
+        workers = max(workers, p_n + d_n)
 
     if trace:
         telemetry.trace.configure(enabled=True)
@@ -52,7 +68,8 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
     cluster, servicers = make_inproc_cluster(
         workers, jax.devices()[:workers])
     clients = [TepdistClient(w.address) for w in cluster.workers]
-    sc = ServeClient(clients=clients)
+    sc = (FleetRouter(clients, prefill=pools[0], decode=pools[1])
+          if pools else ServeClient(clients=clients))
     rng = np.random.RandomState(seed)
     before = telemetry.metrics().snapshot()
     # --shared-prefix: every request opens with the SAME system prompt,
@@ -67,10 +84,16 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
                           size=shared_prefix).astype(np.int32)
               if shared_prefix else np.zeros(0, np.int32))
     try:
-        sc.load(params, cfg, slots=slots, max_len=max_len,
-                name="loadgen", kv_mode=kv_mode, page_size=page_size,
-                hbm_budget_bytes=hbm_budget_bytes,
-                prefill_chunk=prefill_chunk)
+        if pools:
+            sc.load(params, cfg, slots=slots, max_len=max_len,
+                    name="loadgen", page_size=page_size,
+                    hbm_budget_bytes=hbm_budget_bytes,
+                    prefill_chunk=prefill_chunk)
+        else:
+            sc.load(params, cfg, slots=slots, max_len=max_len,
+                    name="loadgen", kv_mode=kv_mode, page_size=page_size,
+                    hbm_budget_bytes=hbm_budget_bytes,
+                    prefill_chunk=prefill_chunk)
         reqs: List[Dict[str, Any]] = []
         if fault_spec:
             faults.configure(fault_spec)
@@ -96,6 +119,11 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
                 reqs.append({"rid": out["request_id"],
                              "prompt_len": len(prompt), "max_new": m,
                              "admission": out["status"]})
+            if pools:
+                # Disaggregated path: move each prefilled request's KV
+                # pages to the decode pool before waiting on results.
+                for r in reqs:
+                    sc.handoff(r["rid"], timeout_s=timeout_s)
             results = sc.wait([r["rid"] for r in reqs],
                               timeout_s=timeout_s)
         finally:
@@ -114,6 +142,17 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
                 ttfts.append(res["ttft_ms"])
             if "decode_ms" in res:
                 decode_ms.append(res["decode_ms"])
+        disagg_leak = None
+        if pools:
+            # Zero-page-leak gate: after both pools drain, every
+            # servable on every worker must hold no used pages — a
+            # handoff that left a page referenced on either side shows
+            # up here.
+            sc.drain_all(wait_ms=5000.0)
+            disagg_leak = 0
+            for s in servicers:
+                for eng in s.servables.values():
+                    disagg_leak += int(eng.stats().get("pages_used", 0))
         trace_path = sc.dump_trace(trace) if trace else None
     finally:
         for s in servicers:
@@ -185,6 +224,20 @@ def run_load(config: str = "test", workers: int = 2, slots: int = 4,
         "requests_replayed": delta("requests_replayed"),
         "drain_handoffs": delta("drain_handoffs"),
         "breaker_trips": delta("serve_breaker_trips"),
+        "disagg": disagg,
+        "disagg_ttft_ms": (round(float(np.mean(sc.ttft_ms)), 3)
+                           if pools and sc.ttft_ms else None),
+        "kv_handoff_ms": (round(float(np.mean(sc.handoff_ms)), 3)
+                          if pools and sc.handoff_ms else None),
+        "pool_handoffs": delta("pool_handoffs") if pools else None,
+        "kv_pages_exported": (delta("kv_pages_exported")
+                              if pools else None),
+        "kv_pages_adopted": (delta("kv_pages_adopted")
+                             if pools else None),
+        "kv_pages_reused": delta("kv_pages_reused") if pools else None,
+        "prefix_affinity_hits": (delta("prefix_affinity_hits")
+                                 if pools else None),
+        "disagg_pages_leaked": disagg_leak,
         "trace": trace_path,
     }
     return summary
@@ -215,6 +268,9 @@ def main(argv=None) -> Dict[str, Any]:
     ap.add_argument("--long-prompt", type=int, default=0,
                     help="make request 0 a long prompt of ~N tokens "
                          "(chunked-prefill TTFT interference probe)")
+    ap.add_argument("--disagg", default=None, metavar="P:D",
+                    help="disaggregated serving: P prefill + D decode "
+                         "replicas with paged KV handoff (FleetRouter)")
     ap.add_argument("--deadline-ms", type=float, default=None)
     ap.add_argument("--fault-spec", default=None,
                     help="runtime/faults.py grammar, e.g. "
@@ -240,7 +296,8 @@ def main(argv=None) -> Dict[str, Any]:
         hbm_budget_bytes=args.hbm_budget,
         prefill_chunk=args.prefill_chunk,
         shared_prefix=args.shared_prefix,
-        long_prompt=args.long_prompt)
+        long_prompt=args.long_prompt,
+        disagg=args.disagg)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(summary, f, indent=1)
@@ -273,6 +330,15 @@ def main(argv=None) -> Dict[str, Any]:
               f"replayed={summary['requests_replayed']} "
               f"drain_handoffs={summary['drain_handoffs']} "
               f"breaker_trips={summary['breaker_trips']}")
+        if summary["disagg"]:
+            print(f"  disagg={summary['disagg']} "
+                  f"disagg_ttft_ms={summary['disagg_ttft_ms']} "
+                  f"kv_handoff_ms={summary['kv_handoff_ms']} "
+                  f"handoffs={summary['pool_handoffs']} "
+                  f"pages_exported={summary['kv_pages_exported']} "
+                  f"adopted={summary['kv_pages_adopted']} "
+                  f"reused={summary['kv_pages_reused']} "
+                  f"leaked={summary['disagg_pages_leaked']}")
     return summary
 
 
