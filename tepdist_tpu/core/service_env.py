@@ -171,6 +171,21 @@ _ENV_LIST: List[Tuple[str, type, Any, str]] = [
      "targets (step_time_ms percentiles, per-class serve TTFT/token "
      "tails, error rates) for the watchtower's multi-window burn-rate "
      "engine; empty = no SLO evaluation"),
+    # --- control-plane crash safety (WAL + epoch fencing) -----------------
+    ("TEPDIST_WAL_DIR", str, "", "directory for the master's durable "
+     "control-plane journal (runtime/controlplane.py): fsync'd CRC-"
+     "checksummed records of plan dispatches, fleet membership, the "
+     "per-step commit watermark, checkpoint registrations and serving "
+     "transitions. Enables DistributedPipelineSession.readopt() (master "
+     "crash -> replay + re-adopt the live fleet) and arms epoch fencing "
+     "on every mutating verb. Empty = no WAL, no fencing"),
+    ("TEPDIST_WAL_SEGMENT_MB", int, 4, "WAL segment rotation size in MB"),
+    ("TEPDIST_WAL_SNAPSHOT_EVERY", int, 512, "compact the WAL (snapshot "
+     "+ truncate superseded segments) every N appended records; 0 "
+     "disables automatic snapshots (explicit snapshot() only)"),
+    ("TEPDIST_WAL_FSYNC", bool, True, "fsync each WAL group-commit "
+     "batch; 0 trades crash durability for latency (still "
+     "write()-ordered, survives process death but not power loss)"),
     # --- static analysis --------------------------------------------------
     ("TEPDIST_VERIFY_PLAN", bool,
      "pytest" in sys.modules or "PYTEST_CURRENT_TEST" in os.environ,

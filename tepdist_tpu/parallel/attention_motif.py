@@ -209,7 +209,12 @@ def detect_motifs(graph: JaxprGraph,
     for node in graph.nodes:
         if node.prim != "pallas_call":
             continue
-        name = node.eqn.params.get("name") or ""
+        # jax 0.4.x keys the tag as name_and_src_info (a NameAndSrcInfo
+        # whose str() appends " for kernel function ... at file:line");
+        # newer jax keys a plain string under "name". Parse the bare name.
+        name = (node.eqn.params.get("name")
+                or node.eqn.params.get("name_and_src_info") or "")
+        name = getattr(name, "name", name)
         if not str(name).startswith("tepdist_flash_fwd"):
             continue
         try:

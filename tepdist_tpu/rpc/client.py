@@ -113,7 +113,20 @@ class GRPCStub:
             # materialize for gRPC; Frames caches the join, so retries
             # replay identical bytes without re-joining.
             payload = payload.join()
-        resp = self._methods[method](payload, timeout=timeout)
+        try:
+            resp = self._methods[method](payload, timeout=timeout)
+        except Exception as e:  # noqa: BLE001 — re-typed below
+            # Epoch fence (ISSUE 20): the server aborts INTERNAL with the
+            # STALE_EPOCH marker in the details — surface the typed error
+            # so callers (and the retry classifier) see the fence, not a
+            # generic RPC failure.
+            import grpc
+            if isinstance(e, grpc.RpcError) \
+                    and e.code() == grpc.StatusCode.INTERNAL:
+                stale = retry.parse_stale_epoch(e.details() or "")
+                if stale is not None:
+                    raise stale from e
+            raise
         if action == "drop_response":
             raise faults.InjectedFault(
                 f"{method} response dropped", kind="rpc_drop")
@@ -143,6 +156,12 @@ class TepdistClient:
         self.stub = make_stub(address)
         self._uid = uuid.uuid4().hex[:12]
         self._idem_seq = itertools.count(1)
+        # Epoch fence (ISSUE 20): when set, every call carries
+        # ``master_epoch`` in its header and workers reject anything
+        # older than the epoch they have latched (StaleEpochError) — a
+        # wedged-then-revived old master cannot poison the fleet. None =
+        # unfenced (single-master setups that never enable the WAL).
+        self.epoch: Optional[int] = None
 
     # -- generic call --------------------------------------------------
     def call(self, method: str, header: Dict[str, Any],
@@ -156,6 +175,9 @@ class TepdistClient:
         if method in IDEMPOTENT_TOKEN_VERBS and "idem" not in header:
             header = dict(header)
             header["idem"] = f"{self._uid}:{method}:{next(self._idem_seq)}"
+        if self.epoch is not None and "master_epoch" not in header:
+            header = dict(header)
+            header["master_epoch"] = int(self.epoch)
         # Ledger step attribution: the header's step= tag covers the pack
         # (and, in-proc, the whole server handler on this same thread).
         # pack_frames borrows the blob buffers: inproc hands the segments

@@ -120,6 +120,8 @@ class InProcStub:
             raise                     # server-side injection: retryable
         except (ConnectionError, TimeoutError):
             raise                     # nested transport errors propagate
+        except retry.StaleEpochError:
+            raise                     # epoch fence: typed, already fatal
         except Exception as e:
             # gRPC-INTERNAL analogue: application failure, fatal.
             raise retry.ServerError(

@@ -97,6 +97,13 @@ def _build_primitive_registry() -> Dict[str, Any]:
     try:
         import jax._src.shard_map as m12   # shard_map_p: the SPMD wrapper
         modules.append(m12)
+    except ImportError:  # jax<=0.4.x kept it under experimental
+        try:
+            import jax.experimental.shard_map as m12
+            modules.append(m12)
+        except ImportError:  # pragma: no cover - internal layout moved
+            pass
+    try:
         import jax._src.pjit as m13        # sharding_constraint_p etc.
         modules.append(m13)
         modules.append(_core)              # pvary_p (vma adjustment)
@@ -339,7 +346,7 @@ def encode_value(v: Any) -> Any:
         return {"t": "mesh",
                 "abstract": type(v).__name__ == "AbstractMesh",
                 "axis_names": [str(n) for n in v.axis_names],
-                "axis_types": [t.name for t in v.axis_types],
+                "axis_types": [t.name for t in (v.axis_types or ())],
                 "shape": [int(s) for s in v.axis_sizes]}
     if type(v).__name__ == "NamedSharding":
         return {"t": "named_sharding",
@@ -358,20 +365,32 @@ def encode_value(v: Any) -> Any:
         # Avals appear as params of pallas_call (out_avals, GridMapping's
         # index_map/scratch avals, BlockMapping array/block avals).
         return {"t": "aval", "v": _aval_dict(v)}
+    if isinstance(v, jax.ShapeDtypeStruct):
+        # pallas_call's out_shapes on jax 0.4.x carry these directly.
+        return {"t": "sds", "shape": [int(s) for s in v.shape],
+                "dtype": np.dtype(v.dtype).name}
     if _pl_core is not None:
         import dataclasses as _dc
-        for cls_name in ("Blocked", "Element", "Squeezed"):
+        for cls_name in ("Blocked", "Element", "Squeezed", "Unblocked"):
             cls = getattr(_pl_core, cls_name, None)
             if cls is not None and isinstance(v, cls):
+                # On jax 0.4.x Blocked/Unblocked are plain sentinel
+                # classes, not dataclasses — encode with no fields.
+                fields = _dc.fields(cls) if _dc.is_dataclass(cls) else ()
                 return {"t": "pl_dim", "cls": cls_name,
                         "v": [encode_value(getattr(v, f.name))
-                              for f in _dc.fields(cls)]}
+                              for f in fields]}
         for cls_name in ("BlockMapping", "GridMapping"):
             cls = getattr(_pl_core, cls_name, None)
             if cls is not None and isinstance(v, cls):
                 return {"t": "pl_" + cls_name.lower(),
                         "v": {f.name: encode_value(getattr(v, f.name))
                               for f in _dc.fields(cls)}}
+        cls = getattr(_pl_core, "NameAndSrcInfo", None)
+        if cls is not None and isinstance(v, cls):
+            # pallas_call's `name` param on jax 0.4.3x is this two-field
+            # frozen dataclass rather than a plain string.
+            return {"t": "pl_namesrc", "name": v.name, "src": v.src_info}
         try:  # not present on jax 0.4.x (params use plain dicts there)
             from jax._src.frozen_dict import FrozenDict as _FrozenDict
         except ImportError:
@@ -421,9 +440,16 @@ def decode_value(v: Any) -> Any:
         from jax.sharding import AbstractMesh
         return AbstractMesh((), ())
     if t == "mesh":
-        from jax._src.mesh import AxisType
         from jax.sharding import Mesh
-        types = tuple(AxisType[n] for n in v.get("axis_types", [])) or None
+        type_names = v.get("axis_types") or []
+        if type_names:
+            try:
+                from jax._src.mesh import AxisType
+            except ImportError:  # jax 0.4.x spells it AxisTypes
+                from jax._src.mesh import AxisTypes as AxisType
+            types = tuple(AxisType[n] for n in type_names)
+        else:
+            types = None
         n = 1
         for s in v["shape"]:
             n *= s
@@ -431,9 +457,9 @@ def decode_value(v: Any) -> Any:
         if len(devs) < n:
             raise ValueError(
                 f"received mesh needs {n} devices, host has {len(devs)}")
+        kwargs = {} if types is None else {"axis_types": types}
         mesh = Mesh(np.array(devs[:n]).reshape(v["shape"]),
-                    axis_names=tuple(v["axis_names"]),
-                    axis_types=types)
+                    axis_names=tuple(v["axis_names"]), **kwargs)
         if v.get("abstract"):
             # Derive from the concrete local mesh so device_kind/num_cores
             # match the avals the receiver's own trace machinery produces
@@ -458,6 +484,10 @@ def decode_value(v: Any) -> Any:
     if t == "pl_dim":
         cls = getattr(_pl_core, v["cls"])
         return cls(*[decode_value(x) for x in v["v"]])
+    if t == "sds":
+        return jax.ShapeDtypeStruct(tuple(v["shape"]), np.dtype(v["dtype"]))
+    if t == "pl_namesrc":
+        return _pl_core.NameAndSrcInfo(v["name"], v["src"])
     if t in ("pl_blockmapping", "pl_gridmapping"):
         cls = (_pl_core.BlockMapping if t == "pl_blockmapping"
                else _pl_core.GridMapping)
@@ -477,11 +507,12 @@ def decode_value(v: Any) -> Any:
 # --------------------------------------------------------------------------
 
 def _aval_dict(aval) -> dict:
-    if type(aval).__name__ == "AbstractRef":
+    if type(aval).__name__ in ("AbstractRef", "AbstractMemoryRef"):
         # Pallas/state Ref avals (kernel operands, scratch): inner aval +
         # memory space. The memory space is a pallas MemorySpace enum (or
-        # None = default), encoded by name.
-        ms = aval.memory_space
+        # None = default), encoded by name. jax 0.4.x keeps memory_space
+        # on the pallas subclass AbstractMemoryRef rather than the base.
+        ms = getattr(aval, "memory_space", None)
         return {"ref": _aval_dict(aval.inner_aval),
                 "memory_space": None if ms is None else encode_value(ms)}
     if jax.dtypes.issubdtype(aval.dtype, jax.dtypes.extended):
@@ -515,8 +546,14 @@ def _make_aval(d: dict):
     if "ref" in d:
         from jax._src.state.types import AbstractRef
         ms = d.get("memory_space")
-        return AbstractRef(_make_aval(d["ref"]),
-                           None if ms is None else decode_value(ms))
+        ms = None if ms is None else decode_value(ms)
+        try:
+            return AbstractRef(_make_aval(d["ref"]), ms)
+        except TypeError:
+            # jax 0.4.x: base AbstractRef takes only inner_aval; the
+            # memory_space slot lives on the pallas subclass.
+            from jax._src.pallas.core import AbstractMemoryRef
+            return AbstractMemoryRef(_make_aval(d["ref"]), ms)
     if d["dtype"] == "float0":
         return _core.ShapedArray(tuple(d["shape"]), jax.dtypes.float0)
     kw = {}

@@ -118,6 +118,47 @@ class ServerError(RuntimeError):
     retried. The in-proc transport's analogue of gRPC INTERNAL."""
 
 
+class StaleEpochError(ServerError):
+    """Epoch fence (ISSUE 20): a mutating verb carried a ``master_epoch``
+    older than the one this worker has already latched — the sender is a
+    wedged-then-revived old master and must NOT mutate fleet state.
+    Fatal by construction (a retry replays the same stale epoch); the
+    rejected handler guarantees no state changed before raising.
+
+    Both transports preserve the type: the in-proc stub re-raises it
+    unwrapped, and the gRPC stub re-types INTERNAL aborts whose details
+    carry the ``STALE_EPOCH`` marker (see ``parse_stale_epoch``)."""
+
+    MARKER = "STALE_EPOCH"
+
+    def __init__(self, message: str, *, seen: Optional[int] = None,
+                 current: Optional[int] = None):
+        super().__init__(message)
+        self.seen = seen          # the stale epoch the request carried
+        self.current = current    # the epoch the worker has latched
+
+
+def parse_stale_epoch(details: str) -> Optional[StaleEpochError]:
+    """Re-type a gRPC INTERNAL's repr'd details back into a
+    ``StaleEpochError`` when the marker rode along (wire format:
+    ``... STALE_EPOCH seen=<n> current=<m> ...``)."""
+    if StaleEpochError.MARKER not in details:
+        return None
+    seen = current = None
+    for tok in details.replace("'", " ").replace('"', " ").split():
+        if tok.startswith("seen="):
+            try:
+                seen = int(tok[5:].rstrip(",)"))
+            except ValueError:
+                pass
+        elif tok.startswith("current="):
+            try:
+                current = int(tok[8:].rstrip(",)"))
+            except ValueError:
+                pass
+    return StaleEpochError(details, seen=seen, current=current)
+
+
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
     """Exponential backoff with multiplicative jitter."""

@@ -33,6 +33,7 @@ import jax
 from tepdist_tpu.core.mesh import MeshTopology
 from tepdist_tpu.core.service_env import ServiceEnv
 from tepdist_tpu.rpc import protocol
+from tepdist_tpu.rpc import retry as rpc_retry
 from tepdist_tpu.rpc.jaxpr_serde import deserialize_closed_jaxpr
 from tepdist_tpu.runtime import faults
 from tepdist_tpu.telemetry import flight
@@ -190,6 +191,13 @@ class TepdistServicer:
         # worker resuming a wedged step cannot poison the rebuilt plan's
         # data plane with stale activations (same step index, old plan).
         self.plan_gen = 0
+        # Epoch fence (ISSUE 20): highest master_epoch this worker has
+        # seen on any header. Mutating verbs carrying an OLDER epoch are
+        # rejected with StaleEpochError before any state changes — a
+        # wedged-then-revived old master cannot poison a fleet that a
+        # newer master has re-adopted. -1 = never fenced (headers without
+        # the field always pass; unfenced setups keep working).
+        self.master_epoch = -1
         # Idempotency dedup: token -> cached response bytes for mutating
         # verbs (ExecutePlan / DispatchPlan / TransferToServerHost). A
         # client retry whose original request WAS applied (response lost
@@ -247,6 +255,27 @@ class TepdistServicer:
                 while len(self._idem_cache) > self._IDEM_CACHE_MAX:
                     self._idem_cache.popitem(last=False)
         return resp
+
+    def _check_epoch(self, header) -> None:
+        """Epoch fence: latch newer epochs, reject older ones (ISSUE 20).
+        Runs FIRST in every mutating handler — before the idem cache,
+        before fault injection, before any effect — so a rejected verb
+        provably mutated nothing (not even a cached response replay)."""
+        e = header.get("master_epoch")
+        if e is None:
+            return
+        e = int(e)
+        with self._lock:
+            cur = self.master_epoch
+            if e >= cur:
+                self.master_epoch = e
+                return
+        metrics().counter("stale_epoch_rejections").inc()
+        log.warning("worker %d rejected stale master_epoch %d (< %d)",
+                    self.task_index, e, cur)
+        raise rpc_retry.StaleEpochError(
+            f"STALE_EPOCH seen={e} current={cur} worker={self.task_index}",
+            seen=e, current=cur)
 
     def _inject_server_fault(self, verb: str) -> None:
         plan = faults.active()
@@ -774,6 +803,7 @@ class TepdistServicer:
         input, keyed by global arg index (reference
         TransferToServerRequest.{variable,global_idx})."""
         header, blobs = protocol.unpack(request)
+        self._check_epoch(header)
         cached = self._idem_get(header)
         if cached is not None:
             return cached
@@ -791,6 +821,7 @@ class TepdistServicer:
         """Raw-keyed per-step data (reference: per-step input slices +
         peer-to-peer activation pushes in the RPC transport)."""
         header, blobs = protocol.unpack(request)
+        self._check_epoch(header)
         if "raw_key" in header or "raw_multi" in header:
             self._inject_server_fault("TransferHostRawData")
             gen = header.get("plan_gen")
@@ -829,6 +860,7 @@ class TepdistServicer:
 
     def TransferVarArgMap(self, request: bytes, context=None) -> bytes:
         header, _ = protocol.unpack(request)
+        self._check_epoch(header)
         self.var_arg_map = {int(k): int(v)
                             for k, v in header["var_arg_map"].items()}
         return protocol.pack({"ok": True})
@@ -916,6 +948,7 @@ class TepdistServicer:
 
     def ExecutePlan(self, request: bytes, context=None) -> bytes:
         header, blobs = protocol.unpack(request)
+        self._check_epoch(header)
         cached = self._idem_get(header)
         if cached is not None:
             return cached
@@ -1049,6 +1082,7 @@ class TepdistServicer:
         build the jitted runtime for it (reference: create_def_ctx_from_proto
         + module rebuild, service_rt.cc:467)."""
         header, blobs = protocol.unpack(request)
+        self._check_epoch(header)
         module_id = int(header.get("module_id", 0))
         self.modules[module_id] = blobs[0]
         meta = header.get("stage_meta")
@@ -1068,6 +1102,7 @@ class TepdistServicer:
         executable WorkerPlan (reference: BuildDistributedPlanRPC,
         virtual_client.cc:776)."""
         header, _ = protocol.unpack(request)
+        self._check_epoch(header)
         cached = self._idem_get(header)
         if cached is not None:
             # The original DispatchPlan was applied and its response lost:
@@ -1122,6 +1157,7 @@ class TepdistServicer:
 
     def ExecuteRemotePlan(self, request: bytes, context=None) -> bytes:
         header, _ = protocol.unpack(request)
+        self._check_epoch(header)
         # Injection BEFORE run_step: the step-result cache makes a replay
         # of an executed step a cache hit, so a post-run fault would only
         # exercise the rpc retry, never the master's _recover_step ladder.
@@ -1149,6 +1185,7 @@ class TepdistServicer:
         a transport-retried or master-retried slice dedups exactly like
         ExecuteRemotePlan."""
         header, blobs = protocol.unpack(request)
+        self._check_epoch(header)
         # Injection BEFORE any effect (mirrors ExecuteRemotePlan): the
         # completed-step cache makes a replay a cache hit, so a post-run
         # fault would only exercise the rpc retry, never the master's
@@ -1173,6 +1210,7 @@ class TepdistServicer:
 
     def InitMeshTopology(self, request: bytes, context=None) -> bytes:
         header, _ = protocol.unpack(request)
+        self._check_epoch(header)
         self.cluster_spec = header.get("cluster_spec", {})
         return protocol.pack({"ok": True,
                               "n_devices": len(self.devices)})
@@ -1180,6 +1218,7 @@ class TepdistServicer:
     # ------------------------------------------------------------------
     def DoRemoteSave(self, request: bytes, context=None) -> bytes:
         header, _ = protocol.unpack(request)
+        self._check_epoch(header)
         gs = header.get("global_step")
         opts = {"max_to_keep": int(header.get("max_to_keep") or 5),
                 "global_step": self.global_step if gs is None else int(gs)}
@@ -1191,6 +1230,7 @@ class TepdistServicer:
 
     def DoRemoteRestore(self, request: bytes, context=None) -> bytes:
         header, _ = protocol.unpack(request)
+        self._check_epoch(header)
         opts = {"global_step": int(header.get("global_step", -1)),
                 "all_shards": bool(header.get("all_shards"))}
         if header.get("lazy"):
@@ -1293,6 +1333,7 @@ class TepdistServicer:
         fleet with a plain AbortStep, then resets before re-executing the
         same step from the already-received inputs."""
         header, _ = protocol.unpack(request)
+        self._check_epoch(header)
         if header.get("reset"):
             self.raw_store.reset_abort()
             return protocol.pack({"ok": True, "reset": True})
@@ -1440,6 +1481,7 @@ class TepdistServicer:
            "addr": ... | "ckpt_step"/"worker_id": ..., "wire_dtype": opt}
         """
         header, _ = protocol.unpack(request)
+        self._check_epoch(header)
         cached = self._idem_get(header)
         if cached is not None:
             return cached
@@ -1484,6 +1526,11 @@ class TepdistServicer:
             "n_devices": len(self.devices),
             "platform": self.devices[0].platform,
             "global_step": self.global_step,
+            # Master re-adoption probe (ISSUE 20): a restarted master
+            # reconciles its WAL state against the plan generation the
+            # fleet actually runs and the highest epoch it has latched.
+            "plan_gen": self.plan_gen,
+            "master_epoch": self.master_epoch,
         }
         # Live migration checkpoint probe: the manifest lives in the
         # WORKERS' shared checkpoint dir (the master's filesystem/env may
@@ -1592,6 +1639,7 @@ class TepdistServicer:
         Idempotent: a replayed load answers with the original servable
         id instead of building a second engine."""
         header, blobs = protocol.unpack(request)
+        self._check_epoch(header)
         cached = self._idem_get(header)
         if cached is not None:
             return cached
@@ -1695,6 +1743,7 @@ class TepdistServicer:
         response cache (bounded LRU) and the engine's request-id dedup —
         a replay past the cache still cannot generate twice."""
         header, blobs = protocol.unpack(request)
+        self._check_epoch(header)
         cached = self._idem_get(header)
         if cached is not None:
             return cached
@@ -1726,6 +1775,7 @@ class TepdistServicer:
 
     def CancelRequest(self, request: bytes, context=None) -> bytes:
         header, _ = protocol.unpack(request)
+        self._check_epoch(header)
         cached = self._idem_get(header)
         if cached is not None:
             return cached
@@ -1743,6 +1793,7 @@ class TepdistServicer:
         lost response would lose the handed-off requests (the re-run
         would find an already-empty queue)."""
         header, _ = protocol.unpack(request)
+        self._check_epoch(header)
         cached = self._idem_get(header)
         if cached is not None:
             return cached
@@ -1793,6 +1844,7 @@ class TepdistServicer:
         fault would only exercise the retry + dedup cache, never an
         interrupted adoption."""
         header, blobs = protocol.unpack(request)
+        self._check_epoch(header)
         cached = self._idem_get(header)
         if cached is not None:
             return cached
@@ -1827,6 +1879,7 @@ class TepdistServicer:
         into later ones. Exact ``cfg.dtype`` activation bytes ride back
         on the Frames path — the sharded bit-identity contract."""
         header, blobs = protocol.unpack(request)
+        self._check_epoch(header)
         self._inject_server_fault("ExecuteServableSlice")
         sv = self._servable(header["servable_id"])
         arr = protocol.decode_literal(header["array"], blobs[0])

@@ -48,7 +48,10 @@ class DistributedPipelineSession:
                  learning_rate: float = 0.01, optimizer=None,
                  elastic: bool = False, autosave_every: int = 1,
                  carry_state: bool = False,
-                 carry_stages: Optional[Dict[int, List[int]]] = None):
+                 carry_stages: Optional[Dict[int, List[int]]] = None,
+                 wal_dir: Optional[str] = None,
+                 master_epoch: Optional[int] = None,
+                 adopt: bool = False):
         """``optimizer``: an optax GradientTransformation; its init and
         update functions are TRACED per stage (over that stage's owned
         params) and shipped to workers as serialized jaxprs — any optax
@@ -69,7 +72,23 @@ class DistributedPipelineSession:
         DispatchPlan tells each worker to CARRY the named stages'
         optimizer slots across the plan swap (kept or just-adopted)
         instead of letting the fresh WorkerPlan lazily re-run opt_init.
-        ``carry_stages`` maps task_index -> stage indices."""
+        ``carry_stages`` maps task_index -> stage indices.
+
+        ``wal_dir`` (control-plane crash safety, ISSUE 20): enable the
+        durable write-ahead journal (runtime/controlplane.py) — plan
+        dispatches, fleet membership, the per-step commit watermark and
+        checkpoint registrations are journaled so a restarted master can
+        ``readopt()`` the live fleet. Defaults to the TEPDIST_WAL_DIR
+        knob; empty = disabled. Opening the WAL also arms epoch fencing:
+        the session claims ``epoch = replayed epoch + 1`` and stamps it
+        on every verb. ``master_epoch`` overrides the claimed epoch
+        (used by the rebuild paths to keep the current fence).
+
+        ``adopt=True`` (readopt() only): build all master-side plan
+        state but ship NOTHING — no module transfer, no DispatchPlan.
+        The fleet already holds the modules, the WorkerPlans, and the
+        variables; the caller reconciles ``_plan_gen``/``_step`` from
+        the WAL + Ping probes."""
         from tepdist_tpu.rpc.jaxpr_serde import serialize_closed_jaxpr
 
         self.prog = prog
@@ -97,6 +116,33 @@ class DistributedPipelineSession:
             w.task_index: TepdistClient(w.address)
             for w in cluster.workers
         }
+        # Control-plane WAL + epoch fence (ISSUE 20). The WAL opens (and
+        # the epoch is claimed + durably journaled) BEFORE any RPC ships,
+        # so a crash mid-construction still leaves the claimed epoch on
+        # disk and a takeover cannot regress it.
+        from tepdist_tpu.runtime import controlplane
+        self._wal: Optional[controlplane.ControlPlaneWAL] = None
+        self._epoch: Optional[int] = master_epoch
+        wal_dir = wal_dir or _SE.get().tepdist_wal_dir or None
+        self._wal_dir = wal_dir
+        # An explicit master_epoch means the CALLER owns the WAL + fence
+        # (rebuild paths hand theirs across the session swap; readopt
+        # opens its own after replay) — never open a second writer here.
+        if wal_dir and not adopt and master_epoch is None:
+            env0 = _SE.get()
+            state0 = controlplane.replay(wal_dir)
+            self._wal = controlplane.ControlPlaneWAL(
+                wal_dir,
+                segment_bytes=env0.tepdist_wal_segment_mb * (1 << 20),
+                snapshot_every=env0.tepdist_wal_snapshot_every,
+                fsync=env0.tepdist_wal_fsync,
+                on_error=self._wal_error)
+            if self._epoch is None:
+                self._epoch = state0.epoch + 1
+            controlplane.log_epoch(self._wal, self._epoch)
+        if self._epoch is not None:
+            for c in self.clients.values():
+                c.epoch = self._epoch
         # Pseudo device groups: one per worker (cross-worker placement).
         stage_devices = [(self.stage_worker[s],) for s in range(S)]
         self.dag, self.maps = build_pipeline_task_dag(prog, stage_devices)
@@ -214,9 +260,10 @@ class DistributedPipelineSession:
                         jax.tree_util.tree_leaves(state_shape))
                     blobs.append(serialize_closed_jaxpr(init_closed))
                     blobs.append(serialize_closed_jaxpr(update_closed))
-            self.clients[self.stage_worker[s]].call(
-                "TransferModuleAndDefCtx",
-                {"module_id": s, "stage_meta": meta}, blobs)
+            if not adopt:
+                self.clients[self.stage_worker[s]].call(
+                    "TransferModuleAndDefCtx",
+                    {"module_id": s, "stage_meta": meta}, blobs)
 
         # Dispatch per-worker plans in global schedule order, with the GC
         # plan computed for that order (workers prune via mem_to_release).
@@ -275,7 +322,10 @@ class DistributedPipelineSession:
                 if carry_stages is not None:
                     dispatch_hdr["carry_stages"] = sorted(
                         carry_stages.get(ti, ()))
-            self.clients[ti].call("DispatchPlan", dispatch_hdr)
+            if not adopt:
+                self.clients[ti].call("DispatchPlan", dispatch_hdr)
+        if not adopt:
+            self._wal_log_plan()
         self._step = 0
         self._step_attempts = 0
         # Live migration state (ISSUE 18): revived workers queue here
@@ -339,6 +389,50 @@ class DistributedPipelineSession:
             for ti in workers:
                 placement.setdefault(ti, set()).add(gi)
         return placement
+
+    # ------------------------------------------------------------------
+    # Control-plane WAL helpers (ISSUE 20).
+    def _plan_fingerprint(self) -> str:
+        """Stable digest of what the fleet is running — enough for a
+        re-adopting master to detect a WAL that describes a DIFFERENT
+        program than the one it was handed."""
+        import hashlib
+        import json as _json
+        payload = _json.dumps({
+            "stages": self.prog.num_stages,
+            "micro": self.prog.num_micro_batches,
+            "stage_worker": list(self.stage_worker),
+            "members": sorted(self.clients),
+            "comm_dtype": getattr(self.prog, "comm_dtype", "") or "",
+            "zero": bool(getattr(self.prog, "zero", False)),
+        }, sort_keys=True).encode()
+        return hashlib.blake2b(payload, digest_size=8).hexdigest()
+
+    def _wal_log_plan(self) -> None:
+        if self._wal is None:
+            return
+        from tepdist_tpu.runtime import controlplane
+        prog = self.prog
+        controlplane.log_plan(
+            self._wal,
+            plan_gen=self._plan_gen,
+            fingerprint=self._plan_fingerprint(),
+            plan_meta={"num_micro_batches": prog.num_micro_batches,
+                       "comm_dtype": getattr(prog, "comm_dtype", "")
+                       or "",
+                       "zero": bool(getattr(prog, "zero", False))},
+            stage_worker=list(self.stage_worker),
+            members={w.task_index: w.address
+                     for w in self.cluster.workers})
+
+    def _wal_error(self, exc: BaseException) -> None:
+        """ControlPlaneWAL on_error hook (writer thread): a journal that
+        stops journaling silently would turn the next takeover into a
+        rollback — surface it loudly on the alert board."""
+        from tepdist_tpu.telemetry import watchtower
+        watchtower.control_plane_alert(
+            f"control-plane WAL write failed: {exc!r}",
+            wal_dir=self._wal_dir or "")
 
     def load_variables(self, params) -> None:
         flat = jax.tree_util.tree_leaves(params)
@@ -558,6 +652,13 @@ class DistributedPipelineSession:
         self._step += 1
         self._redispatch_attempts = 0   # a full step succeeded: reset cap
         self._step_attempts = 0
+        if self._wal is not None:
+            # Async group commit: the step record rides the next fsync
+            # batch off the critical path. Losing the tail record on a
+            # crash resumes ONE step early — absorbed bit-identically by
+            # the workers' completed-step caches.
+            from tepdist_tpu.runtime import controlplane
+            controlplane.log_step(self._wal, self._step - 1)
         losses = results[self.loss_worker].get("losses", [])
         if (self._elastic and self._autosave_every > 0
                 and self._step % self._autosave_every == 0):
@@ -594,6 +695,12 @@ class DistributedPipelineSession:
         status = self.health.check_once()
         newly_dead = {ti for ti in errs if not status.get(ti, False)}
         self.health.mark_dead(newly_dead)
+        if self._wal is not None and newly_dead:
+            from tepdist_tpu.runtime import controlplane
+            for ti in sorted(newly_dead):
+                w = self._known_workers.get(ti)
+                controlplane.log_member(
+                    self._wal, ti, w.address if w else "", action="dead")
         # A straggler thread still alive here means some ExecuteRemotePlan
         # may STILL be running server-side; likewise a deadline-exceeded
         # execute on a ping-alive worker. Re-executing concurrently with
@@ -749,14 +856,18 @@ class DistributedPipelineSession:
         template = self._params_template
         elastic, autosave = self._elastic, self._autosave_every
         attempts = getattr(self, "_redispatch_attempts", 0)
+        wal, epoch, wdir = self._wal, self._epoch, self._wal_dir
         fresh = DistributedPipelineSession(
             self.prog, ClusterSpec(survivors),
             learning_rate=self.lr, optimizer=self._optimizer,
-            elastic=False)   # avoid recursion while adopting
+            elastic=False,   # avoid recursion while adopting
+            master_epoch=epoch)   # keep the fence; caller owns the WAL
         self.__dict__.update(fresh.__dict__)
         self._elastic, self._autosave_every = elastic, autosave
         self._redispatch_attempts = attempts
         self._params_template = template
+        self._wal, self._epoch, self._wal_dir = wal, epoch, wdir
+        self._wal_log_plan()
         self._assign_owners(template)
         restored = -1
         for c in self.clients.values():
@@ -1007,10 +1118,12 @@ class DistributedPipelineSession:
         known = dict(self._known_workers)
         known.update({w.task_index: w for w in new_cluster.workers})
         report = getattr(self, "exploration_report", None)
+        wal, epoch, wdir = self._wal, self._epoch, self._wal_dir
         fresh = DistributedPipelineSession(
             prog, new_cluster, learning_rate=self.lr,
             optimizer=self._optimizer, elastic=False,
-            carry_state=True, carry_stages=carry)
+            carry_state=True, carry_stages=carry,
+            master_epoch=epoch)   # keep the fence; caller owns the WAL
         self.__dict__.update(fresh.__dict__)
         self._elastic, self._autosave_every = elastic, autosave
         self._redispatch_attempts = attempts
@@ -1019,6 +1132,8 @@ class DistributedPipelineSession:
         self._migration_seq = mig_seq
         self._pending_rejoin = pending
         self._known_workers = known
+        self._wal, self._epoch, self._wal_dir = wal, epoch, wdir
+        self._wal_log_plan()
         if report is not None:
             self.exploration_report = report
         self._assign_owners(template)
@@ -1044,6 +1159,10 @@ class DistributedPipelineSession:
         for c in self.clients.values():
             c.do_remote_save(max_to_keep=max_to_keep,
                              global_step=self._step)
+        if self._wal is not None:
+            from tepdist_tpu.runtime import controlplane
+            controlplane.log_ckpt(self._wal, self._step)
+            self._wal.maybe_snapshot()
 
     def restore(self, global_step: int = -1) -> None:
         for c in self.clients.values():
@@ -1094,6 +1213,108 @@ class DistributedPipelineSession:
         sess.restore(global_step)
         return sess
 
+    @classmethod
+    def readopt(cls, prog, cluster, params_template, optimizer=None,
+                learning_rate=0.01, wal_dir: Optional[str] = None,
+                elastic: bool = False, autosave_every: int = 1
+                ) -> "DistributedPipelineSession":
+        """Re-adopt a LIVE fleet after a master crash (ISSUE 20): replay
+        the control-plane WAL, claim the next epoch (fencing out the old
+        master if it revives), Ping the still-running workers to learn
+        the fleet's actual plan generation / completed steps, and resume
+        at the journaled watermark — WITHOUT re-shipping modules, plans,
+        or weights. The fleet's RawStores, WorkerPlans and variables are
+        all still server-side; workers ahead of the watermark serve
+        their completed-step caches (bit-identical re-run), workers
+        blocked in recvs are unwedged by the fence+reset.
+
+        Unreachable workers fall to the existing elastic ladder (live
+        migration, then checkpoint re-dispatch via restore_resharded
+        move planning). Records ``master_recover_ms`` (gauge + attr) and
+        bumps ``master_takeovers``."""
+        from tepdist_tpu.core.service_env import ServiceEnv
+        from tepdist_tpu.runtime import controlplane
+        t0 = time.monotonic()
+        env = ServiceEnv.get()
+        wal_dir = wal_dir or env.tepdist_wal_dir or None
+        if not wal_dir:
+            raise ValueError(
+                "readopt requires a WAL directory (wal_dir argument or "
+                "TEPDIST_WAL_DIR)")
+        state = controlplane.replay(wal_dir)
+        epoch = state.epoch + 1
+        # adopt=True: full master-side plan state, ZERO fleet mutation.
+        sess = cls(prog, cluster, learning_rate=learning_rate,
+                   optimizer=optimizer, elastic=elastic,
+                   autosave_every=autosave_every,
+                   wal_dir=wal_dir, master_epoch=epoch, adopt=True)
+        sess._wal = controlplane.ControlPlaneWAL(
+            wal_dir,
+            segment_bytes=env.tepdist_wal_segment_mb * (1 << 20),
+            snapshot_every=env.tepdist_wal_snapshot_every,
+            fsync=env.tepdist_wal_fsync,
+            on_error=sess._wal_error)
+        controlplane.log_epoch(sess._wal, epoch)
+        metrics().counter("master_takeovers").inc()
+        # Probe the fleet: the FIRST fenced verb each worker sees latches
+        # the new epoch; Ping itself is unfenced, so probe via the reply
+        # fields instead.
+        statuses: Dict[int, Dict[str, Any]] = {}
+        unreachable: set = set()
+        for ti, c in sess.clients.items():
+            try:
+                statuses[ti] = c.ping(want_ckpt_steps=True)
+            except Exception:  # noqa: BLE001 — dead worker, ladder below
+                unreachable.add(ti)
+        fleet_gens = {int(g) for st in statuses.values()
+                      if (g := st.get("plan_gen")) is not None and g > 0}
+        # The fleet's gen is authoritative over the WAL's (a crash after
+        # DispatchPlan but before the plan record landed): adopt it, and
+        # advance the class counter so future re-dispatches stay ahead.
+        if len(fleet_gens) == 1:
+            sess._plan_gen = fleet_gens.pop()
+        elif state.plan_gen:
+            sess._plan_gen = state.plan_gen
+        cls._gen_counter = max(cls._gen_counter, sess._plan_gen)
+        sess._step = state.step
+        sess._assign_owners(params_template)
+        sess._params_template = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x),
+                                           np.asarray(x).dtype)
+            if not isinstance(x, jax.ShapeDtypeStruct) else x,
+            params_template)
+        # Unwedge stragglers blocked in recvs on data a peer already
+        # sent to the dead master's plan: abort + reset keeps RawStore
+        # data, so the watermark re-run hits caches / kept inputs.
+        sess._reset_fleet_step()
+        if unreachable or len(fleet_gens) > 1:
+            # Inconsistent or shrunken fleet: the standard ladder — live
+            # migration over survivors, checkpoint re-dispatch fallback.
+            sess.health.mark_dead(unreachable)
+            if sess._wal is not None:
+                for ti in sorted(unreachable):
+                    w = sess._known_workers.get(ti)
+                    controlplane.log_member(
+                        sess._wal, ti, w.address if w else "",
+                        action="dead")
+            try:
+                sess._live_migrate()
+            except Exception as e:  # noqa: BLE001 — rung 2 handles it
+                log.warning("readopt live migration failed (%r); falling "
+                            "back to checkpoint re-dispatch", e)
+                sess._auto_redispatch()
+        else:
+            sess._wal_log_plan()   # adopted plan under the new epoch
+        ms = (time.monotonic() - t0) * 1e3
+        m = metrics()
+        m.gauge("master_recover_ms").set(ms)
+        m.histogram("master_recover_ms").observe(ms)
+        sess.last_recover_ms = ms
+        log.warning("master re-adoption complete in %.0f ms: epoch=%d "
+                    "plan_gen=%d step=%d unreachable=%s", ms, epoch,
+                    sess._plan_gen, sess._step, sorted(unreachable))
+        return sess
+
     def close(self) -> None:
         if self.watchtower is not None:
             from tepdist_tpu.telemetry import watchtower
@@ -1103,3 +1324,6 @@ class DistributedPipelineSession:
         self.health.stop()
         for c in self.clients.values():
             c.close()
+        if getattr(self, "_wal", None) is not None:
+            self._wal.close()
+            self._wal = None

@@ -549,6 +549,17 @@ class FleetRouter:
         self._affinity.clear()
         return [sid for _, sid in self._prefill + self._decode]
 
+    def set_epoch(self, epoch: Optional[int]) -> None:
+        """Fence every pool client at ``epoch`` (ISSUE 20): once a new
+        master claims the fleet and bumps ``master_epoch``, a router
+        left over from the deposed one gets ``StaleEpochError`` on its
+        next mutating verb — submit, adopt, release — instead of
+        silently double-driving a handoff against the new owner's
+        bookkeeping. ``None`` disarms (headers stop carrying the
+        epoch)."""
+        for c in self.prefill_clients + self.decode_clients:
+            c.epoch = epoch
+
     # -- prefix-affine prefill routing ---------------------------------
     def _affinity_key(self, prompt) -> Optional[bytes]:
         """PrefixCache's chunk-0 chain key (blake2b over the first
@@ -664,6 +675,11 @@ class FleetRouter:
                     deadline_ms=spec["deadline_ms"],
                     slo_class=spec["slo_class"],
                     wire_dtype=self.wire_dtype))
+            except retry.StaleEpochError:
+                # Deposed master's router: every replica holds the new
+                # fence, so failover would only burn the pool. Surface
+                # the fence — the new master owns this request now.
+                raise
             except (OSError, retry.ServerError) as e:
                 # Dead/crashed decode replica: the failed adopt deleted
                 # its engine record, so the next replica's attempt is

@@ -44,13 +44,32 @@ fault — which the bare engine could only answer with
     journaled and leave no engine record: the same id can be
     resubmitted to another replica.
 
-Counters: ``engine_restarts``, ``requests_replayed``, ``serve_shed``
-(plus everything the engine already emits).
+Retention (ISSUE 20 leak fix): ``_journal`` / ``_completed`` /
+``_delivered`` used to grow for the life of the supervisor — one entry
+per request ever admitted. They are now bounded: a DELIVERED request's
+bookkeeping expires ``completed_ttl_s`` after its first delivery, and
+carried results are LRU-capped at ``completed_cap`` (delivered entries
+evicted first). Within the TTL/cap window the exactly-once guarantees
+are unchanged; past it, a replayed submit of an ancient rid is a fresh
+request — the same contract every bounded idempotency cache on this
+stack already makes (rpc/server.py).
+
+Control-plane journal (ISSUE 20): pass ``wal=`` (a ControlPlaneWAL) and
+every serving-journal transition — admit / finish / deliver (terminal
+status) / handoff — is appended to the master's durable WAL.
+``rebuild_from_wal`` then reconstructs a supervisor after a master
+crash: non-terminal requests replay under their ORIGINAL rids (greedy
+continuations bit-identical, seeded sampling regenerated from the
+seed), terminal-but-undelivered ones re-run and deliver exactly once.
+
+Counters: ``engine_restarts``, ``requests_replayed``, ``serve_shed``,
+``serve_retention_expired`` (plus everything the engine already emits).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import logging
 import threading
 import time
@@ -101,7 +120,10 @@ class ServingSupervisor:
                  n_pages: Optional[int] = None,
                  hbm_budget_bytes: Optional[float] = None,
                  prefix_cache: bool = True,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 completed_cap: int = 1024,
+                 completed_ttl_s: float = 900.0,
+                 wal=None):
         self._params = params
         self._cfg = cfg
         # A rebuilt engine gets the SAME paged-KV geometry, so replay
@@ -132,11 +154,55 @@ class ServingSupervisor:
         self._lock = make_rlock("ServingSupervisor._lock")
         self._journal: Dict[str, _JournalEntry] = {}
         self._completed: Dict[str, Dict[str, Any]] = {}  # dead-gen results
-        self._delivered: set = set()   # rids whose terminal result polled
+        # rid -> monotonic time of FIRST delivery; the retention clock.
+        # (Insertion-ordered dicts give oldest-first iteration for free.)
+        self._delivered: Dict[str, float] = {}
+        self.completed_cap = int(completed_cap)
+        self.completed_ttl_s = float(completed_ttl_s)
+        self._wal = wal
+        self._serve_seq = itertools.count()
         self._shedding = False
         self._threaded = False
         self.restarts = 0
         self.engine = self._make_engine()
+
+    # -- bounded retention (ISSUE 20 leak fix) --------------------------
+    def _prune_locked(self) -> None:
+        """Expire DELIVERED bookkeeping past ``completed_ttl_s`` and cap
+        carried results at ``completed_cap`` (delivered evicted first,
+        then oldest). Non-terminal journal entries — the replay source —
+        are never touched."""
+        now = time.monotonic()
+        drop = [rid for rid, ts in self._delivered.items()
+                if now - ts >= self.completed_ttl_s]
+        over = len(self._completed) - len(
+            [r for r in drop if r in self._completed]) - self.completed_cap
+        if over > 0:
+            spill = sorted(
+                (r for r in self._completed if r not in drop),
+                key=lambda r: r not in self._delivered)
+            drop.extend(spill[:over])
+        for rid in drop:
+            self._delivered.pop(rid, None)
+            self._completed.pop(rid, None)
+            self._journal.pop(rid, None)
+        if drop:
+            metrics().counter("serve_retention_expired").inc(len(drop))
+
+    # -- control-plane journal hooks (ISSUE 20) -------------------------
+    def _wal_serve(self, rid: str, event: str, **fields: Any) -> None:
+        if self._wal is None:
+            return
+        from tepdist_tpu.runtime import controlplane
+        try:
+            controlplane.log_serve(self._wal, rid, event, **fields)
+        except Exception:  # noqa: BLE001 — journal loss must not fail
+            log.exception("serving WAL append failed (%s %s)", rid, event)
+
+    _STATUS_EVENT = {"done": "delivered", "drained": "delivered",
+                     "cancelled": "cancelled", "failed": "failed",
+                     "rejected": "failed", "expired": "expired",
+                     "handed_off": "handoff"}
 
     # -- engine lifecycle ----------------------------------------------
     def _make_engine(self, old: Optional[ServingEngine] = None
@@ -186,6 +252,7 @@ class ServingSupervisor:
 
     def _submit_once(self, rid: str, prompt, **kwargs) -> Dict[str, Any]:
         with self._lock:
+            self._prune_locked()
             eng = self.engine
             if rid in self._journal or rid in self._completed:
                 # Replay of an applied submit: let the engine's dedup
@@ -209,7 +276,7 @@ class ServingSupervisor:
                                   f"watermark {self.shed_high}")}
             out = eng.submit(rid, prompt, **kwargs)
             if out["status"] == "queued":
-                self._journal[rid] = _JournalEntry(
+                e = _JournalEntry(
                     rid=rid,
                     prompt=np.asarray(prompt, np.int32).reshape(-1),
                     max_new_tokens=int(kwargs["max_new_tokens"]),
@@ -220,6 +287,14 @@ class ServingSupervisor:
                     deadline_ms=kwargs.get("deadline_ms"),
                     slo_class=str(kwargs.get("slo_class", "default")),
                     prefill_only=bool(kwargs.get("prefill_only", False)))
+                self._journal[rid] = e
+                self._wal_serve(
+                    rid, "admit", seq=next(self._serve_seq),
+                    prompt=[int(t) for t in e.prompt],
+                    max_new_tokens=e.max_new_tokens, greedy=e.greedy,
+                    temperature=e.temperature, top_k=e.top_k,
+                    seed=e.seed, deadline_ms=e.deadline_ms,
+                    slo_class=e.slo_class, prefill_only=e.prefill_only)
             return out
 
     def cancel(self, rid: str) -> bool:
@@ -243,6 +318,7 @@ class ServingSupervisor:
         # non-blocking snapshot): a snapshot can never interleave with a
         # recovery half-way through moving a prefix into the journal.
         with self._lock:
+            self._prune_locked()
             out = []
             seen = set()
             for r in self.engine.poll(rids, wait_ms=0.0):
@@ -262,10 +338,16 @@ class ServingSupervisor:
                 rid = r.get("request_id")
                 if (r.get("status") in TERMINAL
                         and rid not in self._delivered):
-                    self._delivered.add(rid)
+                    self._delivered[rid] = time.monotonic()
                     flight.record(rid, "deliver",
                                   status=r.get("status"),
                                   n_tokens=r.get("n_tokens", 0))
+                    if rid in self._journal:   # shed/unknown: not ours
+                        st = r.get("status")
+                        self._wal_serve(
+                            rid,
+                            self._STATUS_EVENT.get(st, "delivered"),
+                            n_tokens=r.get("n_tokens", 0))
             return out
 
     def poll(self, rids: Optional[Sequence[str]] = None,
@@ -340,6 +422,9 @@ class ServingSupervisor:
                                                      "duplicate"):
             with self._lock:
                 self._journal.pop(rid, None)
+        elif fresh_entry and out.get("status") == "adopted":
+            self._wal_serve(rid, "handoff", seq=next(self._serve_seq),
+                            adopted=True)
         return out
 
     # -- recovery -------------------------------------------------------
@@ -389,6 +474,11 @@ class ServingSupervisor:
                     self._completed[r.rid] = res
                     flight.record(r.rid, "carry", gen=self.restarts,
                                   status=res.get("status"))
+                    # Finished but not yet delivered: non-terminal in the
+                    # control-plane journal, so a master rebuilt from the
+                    # WAL re-runs it and delivers exactly once.
+                    self._wal_serve(r.rid, "finish",
+                                    status=res.get("status"))
                     continue
                 if e is None:      # pragma: no cover — journal invariant
                     continue
@@ -449,9 +539,45 @@ class ServingSupervisor:
             self.step()
         raise RuntimeError("run_until_idle: scheduler did not drain")
 
+    # -- master-crash rebuild (ISSUE 20) ---------------------------------
+    @classmethod
+    def rebuild_from_wal(cls, params, cfg: GPT2Config, state, *,
+                         wal=None, **kwargs) -> "ServingSupervisor":
+        """Reconstruct a supervisor from a replayed control-plane state
+        (``controlplane.replay(wal_dir)`` or a ControlPlaneState): every
+        NON-terminal journaled request — admitted, finished-but-
+        undelivered, or mid-handoff — is resubmitted under its ORIGINAL
+        rid, in admission order. Greedy requests re-prefill and continue
+        bit-identically; seeded sampling regenerates deterministically
+        from the journaled seed; already-delivered/cancelled/failed rids
+        are NOT replayed (exactly-once delivery across master crashes).
+        ``wal``: the new master's re-opened ControlPlaneWAL, so replayed
+        admissions are journaled under the new epoch."""
+        if isinstance(state, str):
+            from tepdist_tpu.runtime import controlplane
+            state = controlplane.replay(state)
+        sup = cls(params, cfg, wal=wal, **kwargs)
+        for rid, ent in state.pending_serving():
+            prompt = np.asarray(ent.get("prompt", []), np.int32)
+            out = sup.submit(
+                rid, prompt,
+                max_new_tokens=int(ent.get("max_new_tokens", 16)),
+                greedy=bool(ent.get("greedy", True)),
+                temperature=float(ent.get("temperature", 1.0)),
+                top_k=int(ent.get("top_k", 0)),
+                seed=int(ent.get("seed", 0)),
+                deadline_ms=ent.get("deadline_ms"),
+                slo_class=str(ent.get("slo_class", "default")),
+                prefill_only=bool(ent.get("prefill_only", False)))
+            metrics().counter("requests_replayed").inc()
+            flight.record(rid, "replay", gen=-1, prefix=0,
+                          status=out.get("status"))
+        return sup
+
     # -- introspection ---------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         with self._lock:
+            self._prune_locked()
             eng = self.engine
             out = eng.stats()
             out.update({

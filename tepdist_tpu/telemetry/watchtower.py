@@ -95,6 +95,7 @@ KIND_LOSS_SPIKE = "loss_spike"
 KIND_SLO_BURN = "slo_burn"
 KIND_FLEET_SHAPE = "fleet_shape"
 KIND_MIGRATION = "migration"
+KIND_CONTROL_PLANE = "control_plane"
 
 
 @dataclasses.dataclass
@@ -279,6 +280,22 @@ def migration_completed(mig_id: str, stall_ms: Optional[float] = None,
                 + (f" in {stall_ms:.0f} ms" if stall_ms is not None
                    else ""))))
     _BOARD.resolve(f"{KIND_MIGRATION}:{mig_id}")
+
+
+# -- control-plane alerts (ISSUE 20) ----------------------------------------
+
+
+def control_plane_alert(detail: str, wal_dir: str = "",
+                        severity: str = "page") -> HealthAlert:
+    """Publish a ``control_plane`` alert: the master's durable journal
+    stopped journaling (write/fsync failure, lagging group commit). A
+    silent WAL failure would turn the next master takeover into a
+    checkpoint rollback, so this pages by default."""
+    alert = HealthAlert(kind=KIND_CONTROL_PLANE, severity=severity,
+                        name=wal_dir or None, detail=detail)
+    out = _BOARD.publish(alert)
+    metrics().counter("control_plane_alerts").inc()
+    return out
 
 
 # -- training-health sentinels ----------------------------------------------

@@ -1,0 +1,293 @@
+"""Control-plane WAL: record format, torn-tail fuzz, corruption
+classification, snapshot+truncate, group-commit ordering (ISSUE 20)."""
+
+import json
+import os
+import shutil
+import struct
+import threading
+
+import pytest
+
+from tepdist_tpu.runtime import controlplane as cp
+
+
+def _wal(tmp_path, **kw):
+    return cp.ControlPlaneWAL(str(tmp_path / "wal"), **kw)
+
+
+def _seg_path(wal_dir):
+    segs = cp.list_segments(wal_dir)
+    assert segs
+    return os.path.join(wal_dir, segs[-1])
+
+
+class TestRecordFormat:
+    def test_round_trip(self, tmp_path):
+        with _wal(tmp_path) as w:
+            w.append("epoch", epoch=3)
+            w.append("step", step=0)
+            w.append("serve", rid="r1", event="admit", seq=0)
+            w.flush()
+            recs, torn = cp.read_records(w.dir)
+        assert torn == 0
+        assert [r["kind"] for r in recs] == ["epoch", "step", "serve"]
+        assert recs[0]["epoch"] == 3
+
+    def test_reopen_appends_new_segment(self, tmp_path):
+        with _wal(tmp_path) as w:
+            w.append("epoch", epoch=1, sync=True)
+            d = w.dir
+        with cp.ControlPlaneWAL(d) as w2:
+            w2.append("step", step=5, sync=True)
+        recs, _ = cp.read_records(d)
+        assert [r["kind"] for r in recs] == ["epoch", "step"]
+        assert len(cp.list_segments(d)) == 2
+
+    def test_segment_rotation(self, tmp_path):
+        with _wal(tmp_path, segment_bytes=256) as w:
+            for i in range(64):
+                w.append("step", step=i)
+            w.flush()
+            d = w.dir
+        assert len(cp.list_segments(d)) > 1
+        recs, torn = cp.read_records(d)
+        assert torn == 0
+        assert [r["step"] for r in recs] == list(range(64))
+
+
+class TestTornTail:
+    def test_truncate_at_every_tail_byte_offset(self, tmp_path):
+        """Crash mid-write of the final record: replay must succeed at
+        EVERY truncation point inside it, yielding all prior records."""
+        with _wal(tmp_path) as w:
+            for i in range(5):
+                w.append("step", step=i, pad="x" * 40)
+            w.flush()
+            d = w.dir
+        seg = _seg_path(d)
+        data = open(seg, "rb").read()
+        # Byte extent of the final record.
+        off = 0
+        starts = []
+        while off < len(data):
+            starts.append(off)
+            length, _ = struct.Struct("<II").unpack_from(data, off)
+            off += 8 + length
+        tail_start = starts[-1]
+        assert off == len(data)
+        for t in range(tail_start, len(data)):
+            scratch = tmp_path / f"t{t}"
+            shutil.copytree(d, scratch)
+            with open(os.path.join(str(scratch),
+                                   os.path.basename(seg)), "r+b") as f:
+                f.truncate(t)
+            recs, torn = cp.read_records(str(scratch))
+            assert [r["step"] for r in recs] == [0, 1, 2, 3], \
+                f"truncation at byte {t} lost a committed record"
+            assert torn == (1 if t > tail_start else 0)
+            shutil.rmtree(scratch)
+
+    def test_crc_flip_in_final_record_is_torn_tail(self, tmp_path):
+        with _wal(tmp_path) as w:
+            w.append("step", step=0)
+            w.append("step", step=1)
+            w.flush()
+            d = w.dir
+        seg = _seg_path(d)
+        with open(seg, "r+b") as f:
+            data = f.read()
+            f.seek(len(data) - 1)
+            f.write(bytes([data[-1] ^ 0xFF]))
+        recs, torn = cp.read_records(d)
+        assert [r["step"] for r in recs] == [0]
+        assert torn == 1
+
+    def test_replay_tolerates_torn_tail(self, tmp_path):
+        with _wal(tmp_path) as w:
+            w.append("epoch", epoch=2)
+            w.append("step", step=0)
+            w.append("step", step=1)
+            w.flush()
+            d = w.dir
+        with open(_seg_path(d), "r+b") as f:
+            f.truncate(os.path.getsize(_seg_path(d)) - 3)
+        st = cp.replay(d)
+        assert st.epoch == 2
+        assert st.step == 1          # step 1's record was the torn one
+        assert st.torn_tail == 1
+
+
+class TestCorruption:
+    def test_crc_flip_mid_segment_is_typed_error(self, tmp_path):
+        with _wal(tmp_path) as w:
+            for i in range(4):
+                w.append("step", step=i)
+            w.flush()
+            d = w.dir
+        seg = _seg_path(d)
+        data = open(seg, "rb").read()
+        length, _ = struct.Struct("<II").unpack_from(data, 0)
+        # Flip a payload byte of record 0 — records 1..3 follow it.
+        with open(seg, "r+b") as f:
+            f.seek(8 + 2)
+            b = data[8 + 2]
+            f.write(bytes([b ^ 0xFF]))
+        with pytest.raises(cp.WalCorruptError) as ei:
+            cp.read_records(d)
+        assert ei.value.segment == os.path.basename(seg)
+        assert ei.value.offset == 0
+        assert "crc" in ei.value.reason
+
+    def test_torn_record_in_non_last_segment_is_error(self, tmp_path):
+        with _wal(tmp_path, segment_bytes=64) as w:
+            for i in range(8):
+                w.append("step", step=i, pad="y" * 30)
+            w.flush()
+            d = w.dir
+        segs = cp.list_segments(d)
+        assert len(segs) >= 2
+        first = os.path.join(d, segs[0])
+        with open(first, "r+b") as f:
+            f.truncate(os.path.getsize(first) - 2)
+        with pytest.raises(cp.WalCorruptError) as ei:
+            cp.read_records(d)
+        assert ei.value.segment == segs[0]
+
+
+class TestSnapshot:
+    def test_snapshot_truncate_round_trip(self, tmp_path):
+        with _wal(tmp_path) as w:
+            w.append("epoch", epoch=1)
+            w.append("plan", plan_gen=7, fingerprint="fp",
+                     plan_meta={"winner": "pp2"}, stage_worker=[0, 1],
+                     members={"0": "inproc:1", "1": "inproc:2"})
+            for i in range(3):
+                w.append("step", step=i)
+            w.append("serve", rid="r1", event="admit", seq=0)
+            w.flush()
+            pre = cp.replay(w.dir)
+            name = w.snapshot()
+            d = w.dir
+            assert cp.list_snapshots(d) == [name]
+            assert len(cp.list_segments(d)) == 1   # fresh one only
+            # Post-snapshot appends land in the fresh segment.
+            w.append("step", step=3)
+            w.append("serve", rid="r1", event="finish")
+            w.flush()
+        post = cp.replay(d)
+        assert pre.step == 3 and post.step == 4
+        assert post.epoch == 1
+        assert post.plan_gen == 7
+        assert post.plan_meta == {"winner": "pp2"}
+        assert post.members == {0: "inproc:1", 1: "inproc:2"}
+        assert post.serving["r1"]["state"] == "finish"
+
+    def test_snapshot_survives_reopen(self, tmp_path):
+        with _wal(tmp_path) as w:
+            w.append("epoch", epoch=4, sync=True)
+            w.snapshot()
+            d = w.dir
+        with cp.ControlPlaneWAL(d) as w2:
+            w2.append("step", step=0, sync=True)
+        st = cp.replay(d)
+        assert st.epoch == 4 and st.step == 1
+
+    def test_maybe_snapshot_threshold(self, tmp_path):
+        with _wal(tmp_path, snapshot_every=5) as w:
+            for i in range(3):
+                w.append("step", step=i)
+            w.flush()
+            assert not w.maybe_snapshot()
+            for i in range(3, 7):
+                w.append("step", step=i)
+            w.flush()
+            assert w.maybe_snapshot()
+            d = w.dir
+        assert len(cp.list_snapshots(d)) == 1
+        assert cp.replay(d).step == 7
+
+
+class TestGroupCommit:
+    def test_concurrent_appends_keep_per_thread_order(self, tmp_path):
+        with _wal(tmp_path) as w:
+            n, per = 8, 50
+
+            def writer(t):
+                for i in range(per):
+                    w.append("step", step=t * 1000 + i, thread=t)
+
+            ts = [threading.Thread(target=writer, args=(t,))
+                  for t in range(n)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            w.flush()
+            recs, torn = cp.read_records(w.dir)
+        assert torn == 0
+        assert len(recs) == n * per
+        for t in range(n):
+            mine = [r["step"] for r in recs if r["thread"] == t]
+            assert mine == [t * 1000 + i for i in range(per)], \
+                "group commit reordered one thread's records"
+
+    def test_flush_is_durable_barrier(self, tmp_path):
+        with _wal(tmp_path) as w:
+            seq = w.append("step", step=0)
+            w.flush(seq)
+            # Bytes must already be on disk (readable by a cold reader)
+            # without closing the writer.
+            recs, _ = cp.read_records(w.dir)
+        assert recs and recs[0]["step"] == 0
+
+    def test_writer_error_surfaces(self, tmp_path):
+        hits = []
+        w = _wal(tmp_path, on_error=hits.append)
+        w.append("step", step=0, sync=True)
+        w._f.close()                      # journal goes dark
+        w.append("step", step=1)
+        with pytest.raises((RuntimeError, TimeoutError)):
+            w.flush(timeout=5.0)
+        assert hits, "on_error hook (watchtower alert path) never fired"
+
+
+class TestStateReplay:
+    def test_semantics(self, tmp_path):
+        with _wal(tmp_path) as w:
+            w.append("epoch", epoch=1)
+            w.append("member", task_index=0, addr="inproc:1",
+                     action="join")
+            w.append("member", task_index=1, addr="inproc:2",
+                     action="join")
+            w.append("plan", plan_gen=3, fingerprint="fp",
+                     plan_meta={}, stage_worker=[0, 1],
+                     members={"0": "inproc:1", "1": "inproc:2"})
+            w.append("step", step=0)
+            w.append("ckpt", step=1)
+            w.append("step", step=1)
+            w.append("member", task_index=1, addr="inproc:2",
+                     action="dead")
+            w.append("epoch", epoch=2)
+            w.append("serve", rid="a", event="admit", seq=0, gen=1)
+            w.append("serve", rid="b", event="admit", seq=1, gen=1)
+            w.append("serve", rid="a", event="finish")
+            w.append("serve", rid="a", event="delivered")
+            w.flush()
+            st = cp.replay(w.dir)
+        assert st.epoch == 2
+        assert st.plan_gen == 3
+        assert st.step == 2
+        assert st.ckpt_steps == [1]
+        assert st.members == {0: "inproc:1"}
+        assert st.serving["a"]["state"] == "delivered"
+        pend = st.pending_serving()
+        assert [rid for rid, _ in pend] == ["b"]
+
+    def test_unknown_kind_skipped(self, tmp_path):
+        with _wal(tmp_path) as w:
+            w.append("from_the_future", data=1)
+            w.append("step", step=0)
+            w.flush()
+            st = cp.replay(w.dir)
+        assert st.step == 1

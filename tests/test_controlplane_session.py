@@ -1,0 +1,226 @@
+"""Control-plane crash safety, session level (ISSUE 20): the durable
+WAL journals a live training session, a restarted master re-adopts the
+running fleet bit-exactly without re-shipping weights, and epoch fencing
+rejects the revived old master's verbs WITHOUT mutating worker state.
+
+The WAL unit surface (record format, torn tails, CRC, snapshots, group
+commit) is tests/test_controlplane.py; this file is the integration
+half: DistributedPipelineSession + in-proc fleet + readopt().
+"""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tepdist_tpu.parallel.pipeline import plan_pipeline
+from tepdist_tpu.rpc import retry
+from tepdist_tpu.rpc.client import TepdistClient
+from tepdist_tpu.rpc.inproc import (
+    close_inproc_cluster,
+    make_inproc_cluster,
+)
+from tepdist_tpu.runtime import controlplane
+from tepdist_tpu.runtime.distributed_executor import (
+    DistributedPipelineSession,
+)
+from tepdist_tpu.telemetry import metrics, watchtower
+
+
+def _case(stages=2, micro=2, dim=8):
+    def loss_fn(params, x, y):
+        h = x
+        for i in range(2 * stages):
+            h = jnp.tanh(h @ params[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    keys = jax.random.split(k, 2 * stages + 2)
+    params = {f"w{i}": jax.random.normal(keys[i], (dim, dim)) * 0.3
+              for i in range(2 * stages)}
+    x = jax.random.normal(keys[-2], (4 * micro, dim))
+    y = jax.random.normal(keys[-1], (4 * micro, dim))
+    return loss_fn, params, x, y
+
+
+def _batch(i, micro=2, dim=8):
+    r = np.random.default_rng(1000 + i)
+    return (jnp.asarray(r.normal(size=(4 * micro, dim)), jnp.float32),
+            jnp.asarray(r.normal(size=(4 * micro, dim)), jnp.float32))
+
+
+@pytest.fixture
+def clean_board():
+    metrics().reset()
+    watchtower.board().clear()
+    yield
+    watchtower.board().clear()
+
+
+def _run_fleet(steps, wal_dir=None, n=2):
+    """One fleet, one session, ``steps`` deterministic batches. Returns
+    (losses, session, cluster, servicers) WITHOUT closing anything."""
+    loss_fn, params, x, y = _case()
+    cluster, servicers = make_inproc_cluster(n, jax.devices()[:1])
+    prog = plan_pipeline(loss_fn, 2, 2, params, x, y)
+    sess = DistributedPipelineSession(prog, cluster, wal_dir=wal_dir)
+    sess.load_variables(params)
+    losses = [sess.step(*_batch(i)) for i in range(steps)]
+    return losses, sess, cluster, servicers, prog, params
+
+
+# ---------------------------------------------------------------------------
+# WAL journaling of a live session
+# ---------------------------------------------------------------------------
+
+def test_session_journals_plan_steps_and_epoch(tmp_path, clean_board):
+    wal_dir = str(tmp_path / "wal")
+    losses, sess, cluster, servicers, _, _ = _run_fleet(3, wal_dir)
+    try:
+        sess._wal.flush()
+        state = controlplane.replay(wal_dir)
+        assert state.epoch == sess._epoch == 1
+        assert state.step == 3
+        assert state.plan_gen == sess._plan_gen
+        assert sorted(state.members) == [0, 1]
+        assert state.stage_worker == list(sess.stage_worker)
+        assert state.plan_fingerprint == sess._plan_fingerprint()
+        # Every worker latched the session's epoch off the fenced verbs.
+        assert all(s.master_epoch == sess._epoch for s in servicers)
+        assert metrics().counter("wal_records").value > 0
+    finally:
+        sess.close()
+        close_inproc_cluster(cluster)
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: master crash -> readopt() resumes the live fleet bit-exactly
+# ---------------------------------------------------------------------------
+
+def test_readopt_resumes_live_fleet_bit_exact(tmp_path, clean_board):
+    # Fault-free baseline on its own fleet.
+    base, bsess, bcluster, _, _, _ = _run_fleet(6)
+    bsess.close()
+    close_inproc_cluster(bcluster)
+
+    wal_dir = str(tmp_path / "wal")
+    first, s1, cluster, servicers, prog, params = _run_fleet(3, wal_dir)
+    # Master process death: journal handle and heartbeats gone, fleet
+    # (servicers) alive and still holding plans + variables. No close().
+    s1._wal.close()
+    s1.health.stop()
+
+    s2 = DistributedPipelineSession.readopt(prog, cluster, params,
+                                            wal_dir=wal_dir)
+    try:
+        assert s2._step == 3
+        assert s2._epoch == s1._epoch + 1
+        assert s2._plan_gen == s1._plan_gen     # adopted, not re-pushed
+        assert s2.last_recover_ms > 0.0
+        assert metrics().counter("master_takeovers").value == 1
+        start = s2._step
+        rest = [s2.step(*_batch(i)) for i in range(start, 6)]
+        assert first[:start] + rest == base
+        # The revived OLD master is fenced out of every mutating verb.
+        with pytest.raises(retry.StaleEpochError):
+            s1.clients[0].call("AbortStep", {})
+    finally:
+        s2.close()
+        close_inproc_cluster(cluster)
+
+
+def test_readopt_tolerates_torn_wal_tail(tmp_path, clean_board):
+    """Crash mid-append: the last WAL record is torn. Replay drops it —
+    readopt resumes at most one step early, and the workers' completed-
+    step caches make the re-run bit-identical."""
+    base, bsess, bcluster, _, _, _ = _run_fleet(6)
+    bsess.close()
+    close_inproc_cluster(bcluster)
+
+    wal_dir = str(tmp_path / "wal")
+    first, s1, cluster, servicers, prog, params = _run_fleet(3, wal_dir)
+    s1._wal.close()
+    s1.health.stop()
+    seg = sorted(glob.glob(os.path.join(wal_dir, "wal-*.log")))[-1]
+    with open(seg, "rb") as f:
+        data = f.read()
+    with open(seg, "wb") as f:
+        f.write(data[:-3])          # tear the final record mid-payload
+
+    s2 = DistributedPipelineSession.readopt(prog, cluster, params,
+                                            wal_dir=wal_dir)
+    try:
+        assert s2._step in (2, 3)   # at most ONE step early
+        start = s2._step
+        rest = [s2.step(*_batch(i)) for i in range(start, 6)]
+        assert first[:start] + rest == base
+    finally:
+        s2.close()
+        close_inproc_cluster(cluster)
+
+
+# ---------------------------------------------------------------------------
+# Epoch fencing: stale dispatch rejected with NO state mutation
+# ---------------------------------------------------------------------------
+
+def test_stale_epoch_rejected_without_mutation(clean_board):
+    losses, sess, cluster, servicers, _, _ = _run_fleet(2)
+    try:
+        # Arm the fence (no WAL needed for the fence itself).
+        sess._epoch = 7
+        for c in sess.clients.values():
+            c.epoch = 7
+        sess.step(*_batch(2))       # fleet latches epoch 7
+        assert all(s.master_epoch == 7 for s in servicers)
+
+        w0 = servicers[0]
+        before_vars = {gi: np.asarray(v)
+                       for gi, v in w0.variables.items()}
+        before_gen = w0.plan_gen
+        stale = TepdistClient(cluster.workers[0].address)
+        stale.epoch = 6
+        # A mutating write verb from the stale master: rejected BEFORE
+        # the idempotency cache or any store/variable touch.
+        with pytest.raises(retry.StaleEpochError) as ei:
+            stale.transfer_to_server_host(
+                np.zeros_like(before_vars[0]), 0, variable=True)
+        assert ei.value.seen == 6 and ei.value.current == 7
+        assert w0.plan_gen == before_gen
+        for gi, v in before_vars.items():
+            np.testing.assert_array_equal(np.asarray(w0.variables[gi]), v)
+        assert metrics().counter("stale_epoch_rejections").value >= 1
+        # Equal/newer epochs pass and latch.
+        stale.epoch = 8
+        stale.call("AbortStep", {"reset": True})
+        assert w0.master_epoch == 8
+        stale.close()
+    finally:
+        sess.close()
+        close_inproc_cluster(cluster)
+
+
+def test_rebuild_paths_keep_the_fence(tmp_path, clean_board):
+    """The fresh session built inside migration/redispatch must carry
+    the SAME epoch (construction with master_epoch=...) — an epoch-less
+    rebuild dispatch would let a wedged old master back in."""
+    wal_dir = str(tmp_path / "wal")
+    losses, sess, cluster, servicers, _, _ = _run_fleet(2, wal_dir)
+    try:
+        wal, epoch = sess._wal, sess._epoch
+        assert epoch is not None and wal is not None
+        # The in-place fleet migration rebuilds the session; fence and
+        # journal must survive the swap.
+        sess._params_template is not None
+        sess.migrate_to_fleet(sess.cluster)
+        assert sess._epoch == epoch
+        assert sess._wal is wal
+        assert all(c.epoch == epoch for c in sess.clients.values())
+        sess._wal.flush()
+        state = controlplane.replay(wal_dir)
+        assert state.plan_gen == sess._plan_gen   # rebuilt plan journaled
+    finally:
+        sess.close()
+        close_inproc_cluster(cluster)

@@ -387,3 +387,53 @@ def test_export_release_idempotent_and_dedup(params):
             _ref(params, p, 3))
     finally:
         _teardown(cluster, servicers)
+
+
+def test_router_handoff_fenced_by_epoch(params):
+    """ISSUE 20: a router armed at the OLD master's epoch is rejected
+    with StaleEpochError on submit and on the AdoptPages handoff once a
+    new master latches a higher epoch — the fence surfaces instead of
+    burning through decode replicas as failover — and re-arming at the
+    new epoch resumes normal, bit-exact service."""
+    from tepdist_tpu.rpc import retry
+
+    p = np.random.RandomState(3).randint(
+        1, CFG.vocab_size, size=7).astype(np.int32)
+    cluster, servicers, clients = _cluster(2)
+    router = FleetRouter(clients, prefill=1, decode=1)
+    try:
+        router.load(params, CFG, max_len=64, name="fence")
+        router.set_epoch(5)
+        out = router.submit(p, max_new_tokens=4)   # latches epoch 5
+        rid = out["request_id"]
+        assert servicers[0].master_epoch == 5   # the prefill replica
+        pages_before = _counters().get("kv_pages_adopted", 0)
+
+        # A new master claims the fleet at epoch 6.
+        usurpers = [TepdistClient(w.address) for w in cluster.workers]
+        for u in usurpers:
+            u.epoch = 6
+            u.call("AbortStep", {"reset": True})
+        assert all(s.master_epoch == 6 for s in servicers)
+
+        with pytest.raises(retry.StaleEpochError):
+            router.handoff(rid, timeout_s=30)
+        with pytest.raises(retry.StaleEpochError):
+            router.submit(p, max_new_tokens=4)
+        # The fenced adopt never moved a page (counter is process-
+        # global: assert no growth, not an absolute zero).
+        assert _counters().get("kv_pages_adopted", 0) == pages_before
+
+        # Re-armed at the live epoch the SAME request completes —
+        # the rejected handoff left the prefilled pages untouched.
+        router.set_epoch(6)
+        router.handoff(rid, timeout_s=60)
+        res = router.wait([rid], timeout_s=120)[rid]
+        assert res["status"] == "done"
+        np.testing.assert_array_equal(
+            np.concatenate([p, np.asarray(res["tokens"], np.int32)]),
+            _ref(params, p, 4))
+        for u in usurpers:
+            u.close()
+    finally:
+        _teardown(cluster, servicers)

@@ -34,6 +34,17 @@ subprocesses, one SIGKILLed mid-run; asserts the session completes on the
 reshaped mesh via exactly one live migration (no checkpoint rollback)
 with the trajectory of an undisturbed run, and prints the
 ``migration_stall_ms=`` line scripts/elastic_smoke.sh records.
+
+``--kill-master STEP`` is the control-plane arm (ISSUE 20): the MASTER
+runs as a real subprocess journaling to a durable WAL while the worker
+subprocesses keep running; the driver SIGKILLs the master once STEP
+steps have landed, then starts a fresh master that ``readopt()``s the
+still-live fleet from the WAL — same epoch-fenced takeover an operator
+would run — and finishes the remaining steps WITHOUT re-shipping
+weights. Asserts the merged loss trajectory matches the undisturbed
+reference with any overlapping steps bit-identical (the exactly-once
+evidence), and prints the ``master_recover_ms=`` line
+scripts/controlplane_smoke.sh records.
 """
 
 from __future__ import annotations
@@ -269,6 +280,264 @@ def kill_worker_chaos(args) -> int:
     return 0 if ok else 1
 
 
+def _master_phase(args) -> int:
+    """Hidden subcommand: the MASTER subprocess of the --kill-master arm.
+    phase=run builds the session against the already-running worker
+    fleet, journals to --wal-dir, and appends one fsync'd JSONL loss
+    line per step (the driver watches this file to time the SIGKILL;
+    the WAL is flushed AFTER the line so a kill in the window re-runs
+    at most the last completed step — served from the workers' caches,
+    bit-identically). phase=resume readopt()s the live fleet from the
+    WAL and finishes the run, printing the machine-readable takeover
+    lines the driver forwards."""
+    import json
+
+    import optax
+
+    from tepdist_tpu.core.cluster_spec import ClusterSpec, WorkerSpec
+    from tepdist_tpu.parallel.pipeline import plan_pipeline
+    from tepdist_tpu.runtime.distributed_executor import (
+        DistributedPipelineSession,
+    )
+    from tepdist_tpu.telemetry import metrics
+
+    loss_fn, params, x, y = _build_case(args.stages, args.micro)
+    prog = plan_pipeline(loss_fn, args.stages, args.micro, params, x, y)
+    tx = optax.adam(1e-2)   # stateful: moments must survive the takeover
+    ports = [int(p) for p in args.ports.split(",")]
+    cluster = ClusterSpec([
+        WorkerSpec("127.0.0.1", port, [0], task_index=ti)
+        for ti, port in enumerate(ports)])
+
+    if args.master_phase == "run":
+        sess = DistributedPipelineSession(
+            prog, cluster, optimizer=tx, wal_dir=args.wal_dir,
+            elastic=True, autosave_every=1)
+        sess.health.interval = 0.5
+        sess.load_variables(params)
+        start = 0
+    else:
+        sess = DistributedPipelineSession.readopt(
+            prog, cluster, params, optimizer=tx, wal_dir=args.wal_dir,
+            elastic=True, autosave_every=1)
+        sess.health.interval = 0.5
+        start = sess._step
+        print(f"master_recover_ms={sess.last_recover_ms:.3f}", flush=True)
+        print(f"resumed_at={start} epoch={sess._epoch} "
+              f"plan_gen={sess._plan_gen}", flush=True)
+
+    with open(args.loss_file, "a") as f:
+        for i in range(start, args.steps):
+            loss = sess.step(x, y)
+            f.write(json.dumps({"step": i, "loss": float(loss),
+                                "phase": args.master_phase}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+            if sess._wal is not None:
+                sess._wal.flush()
+
+    if args.master_phase == "resume":
+        counters = metrics().snapshot()["counters"]
+        print(f"master_takeovers={counters.get('master_takeovers', 0)}",
+              flush=True)
+        print("checkpoint_rollback_steps="
+              f"{counters.get('checkpoint_rollback_steps', 0)}",
+              flush=True)
+    sess.close()
+    return 0
+
+
+def kill_master_chaos(args) -> int:
+    """Control-plane crash-safety arm (ISSUE 20): REAL master + worker
+    subprocesses; the master is SIGKILLed after --kill-master steps and
+    a fresh master readopt()s the still-live fleet from the durable WAL.
+    Asserts the merged run-phase + resume-phase loss trajectory covers
+    every step exactly once (overlap must be bit-identical — the
+    workers' completed-step caches serve the re-run), matches the
+    undisturbed local reference, took exactly one takeover, and never
+    rolled back to a checkpoint."""
+    import json
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+    import time as _time
+
+    import optax
+
+    from tepdist_tpu.parallel.pipeline import plan_pipeline
+    from tepdist_tpu.rpc.client import TepdistClient
+
+    kill_step = args.kill_master
+    if not 0 < kill_step < args.steps:
+        print(f"FAIL: --kill-master {kill_step} must fall strictly inside "
+              f"the run (0 < STEP < --steps {args.steps})")
+        return 1
+    loss_fn, params, x, y = _build_case(args.stages, args.micro)
+    prog = plan_pipeline(loss_fn, args.stages, args.micro, params, x, y)
+    tx = optax.adam(1e-2)
+
+    def apply_fn(pp, ss, g):
+        u, ss = tx.update(g, ss, pp)
+        return optax.apply_updates(pp, u), ss
+
+    ref_step = jax.jit(prog.reference_step(apply_fn))
+    p, s = params, tx.init(params)
+    baseline = []
+    for _ in range(args.steps):
+        loss, p, s = ref_step(p, s, x, y)
+        baseline.append(float(loss))
+
+    def free_port():
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            return sk.getsockname()[1]
+
+    tmp = tempfile.mkdtemp(prefix="tepdist_chaos_master_")
+    wal_dir = os.path.join(tmp, "wal")
+    loss_file = os.path.join(tmp, "losses.jsonl")
+    open(loss_file, "w").close()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TEPDIST_CKPT_DIR"] = os.path.join(tmp, "ckpt")  # fallback ladder
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    me = os.path.abspath(__file__)
+    ports = [free_port() for _ in range(args.stages)]
+    workers = [subprocess.Popen(
+        [sys.executable, "-m", "tepdist_tpu.rpc.server",
+         "--port", str(port), "--platform", "cpu",
+         "--task_index", str(ti)],
+        env=env, cwd=root,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for ti, port in enumerate(ports)]
+
+    def master_cmd(phase):
+        return [sys.executable, me, "--master-phase", phase,
+                "--ports", ",".join(str(p_) for p_ in ports),
+                "--wal-dir", wal_dir, "--loss-file", loss_file,
+                "--steps", str(args.steps), "--stages", str(args.stages),
+                "--micro", str(args.micro)]
+
+    master = None
+    resume_out = ""
+    try:
+        for port in ports:
+            c = TepdistClient(f"127.0.0.1:{port}")
+            c.wait_ready(60)
+            c.close()
+        run_log = open(os.path.join(tmp, "master_run.log"), "wb")
+        master = subprocess.Popen(master_cmd("run"), env=env, cwd=root,
+                                  stdout=run_log, stderr=run_log)
+        print(f"chaos: master subprocess journaling to WAL; SIGKILL "
+              f"lands after step {kill_step} of {args.steps}")
+        deadline = _time.monotonic() + 300
+        while _time.monotonic() < deadline:
+            if master.poll() is not None:
+                print(f"FAIL: master exited rc={master.returncode} before "
+                      f"the kill (see {tmp}/master_run.log)")
+                return 1
+            with open(loss_file) as f:
+                done = sum(1 for _ in f)
+            if done >= kill_step:
+                break
+            _time.sleep(0.005)
+        else:
+            print("FAIL: master never reached the kill step in 300 s")
+            return 1
+        master.send_signal(signal.SIGKILL)
+        master.wait()
+        with open(loss_file) as f:
+            run_lines = [json.loads(ln) for ln in f if ln.strip()]
+        print(f"chaos: master killed with {len(run_lines)} step(s) "
+              f"journaled; restarting master from the WAL")
+
+        t0 = _time.monotonic()
+        resume = subprocess.run(master_cmd("resume"), env=env, cwd=root,
+                                capture_output=True, text=True,
+                                timeout=300)
+        wall_ms = (_time.monotonic() - t0) * 1e3
+        resume_out = resume.stdout
+        if resume.returncode != 0:
+            print(f"FAIL: resume master exited rc={resume.returncode}\n"
+                  f"{resume.stdout}\n{resume.stderr}")
+            return 1
+        with open(loss_file) as f:
+            all_lines = [json.loads(ln) for ln in f if ln.strip()]
+    finally:
+        if master is not None and master.poll() is None:
+            master.send_signal(signal.SIGKILL)
+            master.wait()
+        for pr in workers:
+            pr.send_signal(signal.SIGKILL)
+            pr.wait()
+
+    kv = {}
+    for ln in resume_out.splitlines():
+        if "=" in ln and " " not in ln.split("=", 1)[0]:
+            for tok in ln.split():
+                if "=" in tok:
+                    k, _, v = tok.partition("=")
+                    kv[k] = v
+    ok = True
+
+    # Exactly-once: every step exactly one loss; overlapping re-runs
+    # (resume re-serving the last journaled step from worker caches)
+    # must be BIT-identical or the takeover double-applied an update.
+    by_step = {}
+    for ln in all_lines:
+        st, lv = ln["step"], ln["loss"]
+        if st in by_step and by_step[st] != lv:
+            ok = False
+            print(f"FAIL: step {st} re-ran non-identically across the "
+                  f"takeover: {by_step[st]!r} vs {lv!r}")
+        by_step[st] = lv
+    missing = [i for i in range(args.steps) if i not in by_step]
+    if missing:
+        ok = False
+        print(f"FAIL: steps never executed across both masters: {missing}")
+    overlap = len(all_lines) - len(by_step)
+
+    resumed_at = int(kv.get("resumed_at", -1))
+    if not 0 < resumed_at < args.steps:
+        ok = False
+        print(f"FAIL: resume master started at step {resumed_at}; the "
+              f"takeover either lost the watermark or had nothing to do")
+    if kv.get("master_takeovers") != "1":
+        ok = False
+        print(f"FAIL: expected exactly 1 takeover, counted "
+              f"{kv.get('master_takeovers')}")
+    if kv.get("checkpoint_rollback_steps", "0") != "0":
+        ok = False
+        print("FAIL: re-adoption must not roll back to a checkpoint")
+
+    merged = [by_step[i] for i in range(args.steps) if i in by_step]
+    if not missing and not np.allclose(merged, baseline, rtol=1e-4):
+        ok = False
+        print("FAIL: merged loss trajectory diverged from the "
+              "undisturbed run")
+        for i, (a, b) in enumerate(zip(baseline, merged)):
+            mark = "" if np.isclose(a, b, rtol=1e-4) else "   <-- diverged"
+            print(f"  step {i}: clean={a!r} chaos={b!r}{mark}")
+    elif not missing:
+        print(f"loss trajectory matches the undisturbed run over "
+              f"{args.steps} steps through the takeover (resumed at "
+              f"step {resumed_at}, {overlap} cached re-run(s), final "
+              f"loss {merged[-1]:.6f})")
+    if "master_recover_ms" in kv:
+        # Machine-readable: scripts/controlplane_smoke.sh greps this
+        # line into the perf-gate bench history.
+        print(f"master_recover_ms={float(kv['master_recover_ms']):.3f}")
+    else:
+        ok = False
+        print("FAIL: resume master never printed master_recover_ms")
+    print(f"takeover wall (subprocess spawn to fleet resumed): "
+          f"{wall_ms:.0f} ms")
+    shutil.rmtree(tmp, ignore_errors=True)
+    print("PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
 def serve_chaos(args) -> int:
     from tepdist_tpu.telemetry import metrics
 
@@ -332,7 +601,22 @@ def main() -> int:
                     help="elastic arm: SIGKILL a real worker subprocess "
                          "after STEP steps and assert completion on the "
                          "reshaped mesh via one LIVE migration")
+    ap.add_argument("--kill-master", type=int, default=None, metavar="STEP",
+                    help="control-plane arm: SIGKILL the real master "
+                         "subprocess after STEP steps and assert a fresh "
+                         "master re-adopts the live fleet from the WAL "
+                         "bit-exactly")
+    # Hidden plumbing for the --kill-master subprocess phases.
+    ap.add_argument("--master-phase", choices=("run", "resume"),
+                    default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--ports", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--wal-dir", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--loss-file", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.master_phase is not None:
+        return _master_phase(args)
+    if args.kill_master is not None:
+        return kill_master_chaos(args)
     if args.kill_worker is not None:
         return kill_worker_chaos(args)
     if args.serve:

@@ -74,7 +74,13 @@ DEFAULT_KEYS = ("two_worker_fleet_ms", "two_worker_fleet_compressed_ms",
                 # the prefilled -> decoding KV-page handoff itself
                 # (scripts/disagg_smoke.sh records both from
                 # serve_load --disagg).
-                "disagg_ttft_ms", "kv_handoff_ms")
+                "disagg_ttft_ms", "kv_handoff_ms",
+                # ISSUE 20: control-plane crash safety — WAL append cost
+                # on the step path (tools/obs_overhead.py, null-
+                # calibrated) and master takeover wall from WAL replay to
+                # fleet resumed (scripts/controlplane_smoke.sh records it
+                # from chaos_run --kill-master).
+                "master_recover_ms", "wal_overhead_pct")
 
 # Per-key relative noise-band floors overriding the global --band-pct
 # when larger.  The overhead percentages are ratios of two noisy
@@ -94,7 +100,15 @@ BAND_FLOOR_PCT = {"ledger_overhead_pct": 0.15, "flight_overhead_pct": 0.15,
                   # loops + nested RPC pulls; 20% absorbs scheduler
                   # jitter yet still trips the disagg smoke's seeded
                   # 30% regression on kv_handoff_ms.
-                  "disagg_ttft_ms": 0.2, "kv_handoff_ms": 0.2}
+                  "disagg_ttft_ms": 0.2, "kv_handoff_ms": 0.2,
+                  # Master takeover is WAL replay + fleet ping + plan
+                  # reconcile — one-shot wall over process scheduling and
+                  # RPC fan-out, same jitter class as migration_stall_ms.
+                  # 25% still trips the smoke's seeded 50% regression.
+                  "master_recover_ms": 0.25,
+                  # WAL overhead is a ratio of two noisy sub-ms timings,
+                  # same class as the other *_overhead_pct lines.
+                  "wal_overhead_pct": 0.15}
 
 _HIGHER_BETTER_SUFFIXES = ("tok_s", "_x", "_per_s", "_rate", "_speedup")
 _PROMOTE_SUFFIXES = ("_ms", "_us", "_x", "_pct", "tok_s", "_per_s",
